@@ -1,0 +1,27 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A ground-up rebuild of the capabilities of Deeplearning4J 0.9.x
+(reference: zhangxin0820/deeplearning4j) designed for TPU hardware:
+
+- tensor math + autodiff + compilation: JAX / XLA (replacing ND4J/libnd4j/cuDNN)
+- whole-step ``jit`` train programs (replacing the per-layer interpretive loop
+  of ``MultiLayerNetwork.fit`` — reference
+  deeplearning4j-nn/.../nn/multilayer/MultiLayerNetwork.java:1156)
+- declarative, JSON-serializable network configs (parity with
+  ``NeuralNetConfiguration`` / ``MultiLayerConfiguration``)
+- ``jax.sharding.Mesh`` + collectives for all data/model parallelism
+  (replacing ParallelWrapper threads, Spark parameter averaging and the
+  Aeron parameter server).
+
+Top-level convenience re-exports live here; submodules follow the reference's
+module layout (nn, optimize, eval, datasets, parallel, models, nlp, util).
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.nn.conf import (  # noqa: F401
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+    InputType,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401

@@ -1,0 +1,8 @@
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet  # noqa: F401
+from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
+    DataSetIterator,
+    ListDataSetIterator,
+    AsyncDataSetIterator,
+    IrisDataSetIterator,
+    MnistDataSetIterator,
+)

@@ -1,0 +1,82 @@
+"""DataSet / MultiDataSet containers.
+
+Parity surface: ND4J ``org.nd4j.linalg.dataset.DataSet`` / ``MultiDataSet``
+(external to the reference repo, but the currency of every ``fit``/iterator
+API, e.g. MultiLayerNetwork.fit(DataSetIterator) —
+deeplearning4j-nn/.../nn/multilayer/MultiLayerNetwork.java:1156).
+
+Host-side arrays are numpy; transfer to device happens once per step inside
+the jitted train program (minimising host->HBM traffic).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class DataSet:
+    features: np.ndarray
+    labels: np.ndarray
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def shuffle(self, rng: np.random.Generator):
+        idx = rng.permutation(self.num_examples())
+        self.features = self.features[idx]
+        self.labels = self.labels[idx]
+        if self.features_mask is not None:
+            self.features_mask = self.features_mask[idx]
+        if self.labels_mask is not None:
+            self.labels_mask = self.labels_mask[idx]
+
+    def split(self, batch_size: int):
+        n = self.num_examples()
+        out = []
+        for i in range(0, n, batch_size):
+            sl = slice(i, min(i + batch_size, n))
+            out.append(DataSet(
+                self.features[sl], self.labels[sl],
+                None if self.features_mask is None else self.features_mask[sl],
+                None if self.labels_mask is None else self.labels_mask[sl],
+            ))
+        return out
+
+    @staticmethod
+    def merge(datasets: Sequence["DataSet"]) -> "DataSet":
+        f = np.concatenate([d.features for d in datasets], axis=0)
+        l = np.concatenate([d.labels for d in datasets], axis=0)
+        fm = None
+        lm = None
+        if datasets[0].features_mask is not None:
+            fm = np.concatenate([d.features_mask for d in datasets], axis=0)
+        if datasets[0].labels_mask is not None:
+            lm = np.concatenate([d.labels_mask for d in datasets], axis=0)
+        return DataSet(f, l, fm, lm)
+
+
+@dataclasses.dataclass
+class MultiDataSet:
+    """Multi-input/multi-output dataset (ComputationGraph currency)."""
+
+    features: Sequence[np.ndarray]
+    labels: Sequence[np.ndarray]
+    features_masks: Optional[Sequence[Optional[np.ndarray]]] = None
+    labels_masks: Optional[Sequence[Optional[np.ndarray]]] = None
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])
+
+    @staticmethod
+    def from_dataset(ds: DataSet) -> "MultiDataSet":
+        return MultiDataSet(
+            [ds.features], [ds.labels],
+            [ds.features_mask] if ds.features_mask is not None else None,
+            [ds.labels_mask] if ds.labels_mask is not None else None,
+        )

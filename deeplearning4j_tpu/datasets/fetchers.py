@@ -1,0 +1,107 @@
+"""Dataset fetchers.
+
+Parity surface: reference deeplearning4j-core/.../datasets/fetchers/
+(MnistDataFetcher, IrisDataFetcher, ...). The reference downloads + caches
+archives; this environment is zero-egress, so:
+
+- Iris comes from scikit-learn's bundled copy (real Fisher data, no network),
+  with a deterministic synthetic fallback.
+- MNIST loads from a local IDX cache directory if present
+  (``$DL4J_TPU_DATA_DIR`` or ``~/.deeplearning4j_tpu/mnist``), else generates a
+  deterministic synthetic MNIST-shaped dataset: each class is a bright patch at
+  a class-specific location plus noise — linearly separable enough that LeNet
+  converges, so end-to-end training tests remain meaningful.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Tuple
+
+import numpy as np
+
+
+def _one_hot(y: np.ndarray, n: int) -> np.ndarray:
+    out = np.zeros((len(y), n), np.float32)
+    out[np.arange(len(y)), y] = 1.0
+    return out
+
+
+def iris_data() -> Tuple[np.ndarray, np.ndarray]:
+    """(150, 4) features normalized to [0,1] per column, (150, 3) one-hot."""
+    try:
+        from sklearn.datasets import load_iris  # bundled csv, no network
+        d = load_iris()
+        x = d.data.astype(np.float32)
+        y = d.target.astype(np.int64)
+    except Exception:
+        rng = np.random.default_rng(6)
+        means = np.array([[5.0, 3.4, 1.5, 0.2], [5.9, 2.8, 4.3, 1.3], [6.6, 3.0, 5.6, 2.0]],
+                         np.float32)
+        x = np.concatenate([m + 0.3 * rng.standard_normal((50, 4)).astype(np.float32)
+                            for m in means])
+        y = np.repeat(np.arange(3), 50)
+    x = (x - x.min(0)) / (x.max(0) - x.min(0))
+    return x.astype(np.float32), _one_hot(y, 3)
+
+
+def _data_dir() -> str:
+    return os.environ.get("DL4J_TPU_DATA_DIR",
+                          os.path.join(os.path.expanduser("~"), ".deeplearning4j_tpu"))
+
+
+def _read_idx_images(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad idx image magic {magic}"
+        return np.frombuffer(f.read(n * rows * cols), np.uint8).reshape(n, rows * cols)
+
+
+def _read_idx_labels(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad idx label magic {magic}"
+        return np.frombuffer(f.read(n), np.uint8)
+
+
+def _find_mnist_files(train: bool):
+    base = os.path.join(_data_dir(), "mnist")
+    stem = "train" if train else "t10k"
+    for ext in ("", ".gz"):
+        img = os.path.join(base, f"{stem}-images-idx3-ubyte{ext}")
+        lab = os.path.join(base, f"{stem}-labels-idx1-ubyte{ext}")
+        if os.path.exists(img) and os.path.exists(lab):
+            return img, lab
+    return None
+
+
+def synthetic_mnist(num_examples: int, seed: int = 123) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic MNIST-shaped learnable dataset (see module docstring)."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 10, num_examples)
+    x = rng.uniform(0.0, 0.25, (num_examples, 28, 28)).astype(np.float32)
+    # class k lights a 8x8 patch anchored on a 2x5 grid + a class-scaled stripe
+    rows = (y // 5) * 12 + 2
+    cols = (y % 5) * 5 + 1
+    for i in range(num_examples):
+        r, c = rows[i], cols[i]
+        x[i, r:r + 8, c:c + 8] += 0.7
+    x = np.clip(x, 0.0, 1.0)
+    return x.reshape(num_examples, 784), _one_hot(y, 10)
+
+
+def mnist_data(num_examples: int = 60000, train: bool = True,
+               seed: int = 123) -> Tuple[np.ndarray, np.ndarray]:
+    """(n, 784) float32 in [0,1] + (n, 10) one-hot, real if cached locally."""
+    found = _find_mnist_files(train)
+    if found is not None:
+        x = _read_idx_images(found[0]).astype(np.float32) / 255.0
+        y = _read_idx_labels(found[1])
+        n = min(num_examples, len(x))
+        return x[:n], _one_hot(y[:n], 10)
+    n = min(num_examples, 60000 if train else 10000)
+    return synthetic_mnist(n, seed=seed if train else seed + 1)

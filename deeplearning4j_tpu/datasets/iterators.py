@@ -1,0 +1,189 @@
+"""DataSet iterators.
+
+Parity surface: ND4J ``DataSetIterator`` + the reference's canonical iterators
+(deeplearning4j-core/.../datasets/iterator/impl/: MnistDataSetIterator,
+IrisDataSetIterator, ...) and the async prefetch wrapper
+(deeplearning4j-nn/.../datasets/iterator/AsyncDataSetIterator.java).
+
+Iterators are plain Python iterables of :class:`DataSet` with ``reset()``;
+``AsyncDataSetIterator`` prefetches on a background thread so host ETL overlaps
+device compute (same role as the reference's prefetch thread wrapped around
+fit() at MultiLayerNetwork.java:1161).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.fetchers import iris_data, mnist_data
+
+
+class DataSetIterator:
+    """Base iterator (parity: org.nd4j.linalg.dataset.api.iterator.DataSetIterator)."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self._generate()
+
+    def _generate(self):
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+    def batch_size(self) -> int:
+        raise NotImplementedError
+
+    # optional metadata used by networks for shape checks
+    def input_columns(self) -> Optional[int]:
+        return None
+
+    def total_outcomes(self) -> Optional[int]:
+        return None
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate over a pre-split list of DataSets (parity:
+    org.nd4j.linalg.dataset.api.iterator.impl ListDataSetIterator)."""
+
+    def __init__(self, data, batch: Optional[int] = None):
+        if isinstance(data, DataSet):
+            data = data.split(batch or data.num_examples())
+        self._data: List[DataSet] = list(data)
+        self._batch = batch or (self._data[0].num_examples() if self._data else 0)
+
+    def _generate(self):
+        yield from self._data
+
+    def batch_size(self):
+        return self._batch
+
+    def input_columns(self):
+        f = self._data[0].features
+        return int(np.prod(f.shape[1:]))
+
+    def total_outcomes(self):
+        return int(self._data[0].labels.shape[-1])
+
+
+class IrisDataSetIterator(ListDataSetIterator):
+    """Iris fixture iterator (reference
+    deeplearning4j-core/.../datasets/iterator/impl/IrisDataSetIterator.java).
+    Data embedded (150 examples, 4 features, 3 one-hot classes), normalized."""
+
+    def __init__(self, batch: int = 150, num_examples: int = 150, shuffle_seed: Optional[int] = 42):
+        x, y = iris_data()
+        if shuffle_seed is not None:
+            rng = np.random.default_rng(shuffle_seed)
+            idx = rng.permutation(len(x))
+            x, y = x[idx], y[idx]
+        x = x[:num_examples]
+        y = y[:num_examples]
+        super().__init__(DataSet(x, y), batch)
+
+
+class MnistDataSetIterator(ListDataSetIterator):
+    """MNIST iterator (reference
+    deeplearning4j-core/.../datasets/iterator/impl/MnistDataSetIterator.java +
+    fetchers/MnistDataFetcher.java).
+
+    Features are flat (batch, 784) float32 in [0,1] like the reference's
+    binarize=false path. In zero-egress environments (no download), a
+    deterministic synthetic MNIST-shaped dataset is generated instead
+    (class-conditional patterns + noise) so training/tests remain meaningful.
+    """
+
+    def __init__(self, batch: int = 128, num_examples: int = 60000, train: bool = True,
+                 seed: int = 123):
+        x, y = mnist_data(num_examples, train=train, seed=seed)
+        super().__init__(DataSet(x, y), batch)
+
+    def input_columns(self):
+        return 784
+
+    def total_outcomes(self):
+        return 10
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch (reference AsyncDataSetIterator.java).
+
+    On TPU the host ETL / device compute overlap matters just as it did for
+    GPUs; a small bounded queue keeps memory in check.
+    """
+
+    _END = object()
+
+    def __init__(self, base: DataSetIterator, queue_size: int = 4):
+        self._base = base
+        self._queue_size = queue_size
+
+    def reset(self):
+        if hasattr(self._base, "reset"):
+            self._base.reset()
+
+    def _generate(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self._queue_size)
+        err = []
+
+        def worker():
+            try:
+                for ds in self._base:
+                    q.put(ds)
+            except BaseException as e:  # propagate to consumer
+                err.append(e)
+            finally:
+                q.put(self._END)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is self._END:
+                break
+            yield item
+        t.join()
+        if err:
+            raise err[0]
+
+    def batch_size(self):
+        return self._base.batch_size()
+
+    def input_columns(self):
+        return self._base.input_columns()
+
+    def total_outcomes(self):
+        return self._base.total_outcomes()
+
+
+class EarlyTerminationDataSetIterator(DataSetIterator):
+    """Cap the number of minibatches (reference
+    deeplearning4j-nn/.../datasets/iterator/EarlyTerminationDataSetIterator.java)."""
+
+    def __init__(self, base: DataSetIterator, max_batches: int):
+        self._base = base
+        self._max = max_batches
+
+    def reset(self):
+        self._base.reset()
+
+    def _generate(self):
+        for i, ds in enumerate(self._base):
+            if i >= self._max:
+                break
+            yield ds
+
+    def batch_size(self):
+        return self._base.batch_size()
+
+    def input_columns(self):
+        return self._base.input_columns()
+
+    def total_outcomes(self):
+        return self._base.total_outcomes()

@@ -1,0 +1,77 @@
+"""Activation functions.
+
+Parity surface: ND4J ``org.nd4j.linalg.activations.Activation`` (external to the
+reference repo but referenced from every layer config, e.g.
+deeplearning4j-nn/.../nn/conf/layers/Layer.java activation fields). Each entry
+is a pure jax function; autodiff replaces the hand-written backprop() of the
+ND4J activation classes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _identity(x):
+    return x
+
+
+def _leakyrelu(x, alpha=0.01):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def _rationaltanh(x):
+    # ND4J RationalTanh: 1.7159 * tanh_approx(2x/3) with Padé-style approx;
+    # we use the exact form the approximation targets.
+    return 1.7159 * jnp.tanh(2.0 * x / 3.0)
+
+
+def _rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def _hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def _cube(x):
+    return x ** 3
+
+
+def _swish(x):
+    return x * jax.nn.sigmoid(x)
+
+
+ACTIVATIONS = {
+    "identity": _identity,
+    "linear": _identity,
+    "relu": jax.nn.relu,
+    "relu6": lambda x: jnp.clip(x, 0.0, 6.0),
+    "leakyrelu": _leakyrelu,
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu,
+    "swish": _swish,
+    "sigmoid": jax.nn.sigmoid,
+    "hardsigmoid": _hardsigmoid,
+    "tanh": jnp.tanh,
+    "hardtanh": lambda x: jnp.clip(x, -1.0, 1.0),
+    "rationaltanh": _rationaltanh,
+    "rectifiedtanh": _rectifiedtanh,
+    "softmax": lambda x: jax.nn.softmax(x, axis=-1),
+    "logsoftmax": lambda x: jax.nn.log_softmax(x, axis=-1),
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "cube": _cube,
+}
+
+
+def get_activation(name):
+    """Resolve an activation by name (case-insensitive) or pass through a callable."""
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in ACTIVATIONS:
+        raise ValueError(f"Unknown activation '{name}'. Known: {sorted(ACTIVATIONS)}")
+    return ACTIVATIONS[key]

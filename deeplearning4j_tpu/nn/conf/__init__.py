@@ -1,0 +1,5 @@
+from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: F401
+from deeplearning4j_tpu.nn.conf.network import (  # noqa: F401
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+)

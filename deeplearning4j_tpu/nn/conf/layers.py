@@ -1,0 +1,297 @@
+"""Layer configurations + implementations (feed-forward core).
+
+Parity surface: reference ``nn/conf/layers/*`` (declarative configs) together
+with ``nn/layers/*`` (imperative impls). In the TPU rebuild the conf/impl split
+collapses: each config dataclass carries pure ``init``/``apply`` functions that
+JAX traces into one XLA program — the per-layer interpretive loop of
+``MultiLayerNetwork.feedForwardToLayer`` disappears at compile time.
+
+Contract (every layer):
+- ``output_type(input_type) -> InputType``      shape inference
+  (reference: ``Layer.getOutputType`` in nn/conf/layers/Layer.java)
+- ``init(rng, input_type, dtype) -> (params, state)``   params is a dict of
+  arrays; state is a dict for non-trainable buffers (batchnorm running stats)
+  (reference: the ``nn/params/*ParamInitializer`` classes)
+- ``apply(params, state, x, *, train, rng, mask) -> (out, new_state)``
+  (reference: ``Layer.activate`` — nn/api/Layer.java:114-166; backprop is jax
+  autodiff instead of ``Layer.backpropGradient``)
+
+Dropout field semantics follow DL4J 0.9: ``dropout`` is the *retain*
+probability applied to the layer's input when training (0 disables).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.initializers import Distribution, init_weights
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn import lossfunctions
+from deeplearning4j_tpu.optimize.updaters import Updater
+
+LAYER_REGISTRY = {}
+
+
+def register_layer(cls):
+    LAYER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def layer_to_dict(conf) -> dict:
+    d = {"@class": type(conf).__name__}
+    for f in dataclasses.fields(conf):
+        v = getattr(conf, f.name)
+        if v is None:
+            continue
+        if isinstance(v, (Updater,)):
+            v = v.to_dict()
+        elif isinstance(v, Distribution):
+            v = v.to_dict()
+        elif isinstance(v, InputType):
+            v = v.to_dict()
+        elif dataclasses.is_dataclass(v) and hasattr(v, "to_dict"):
+            v = v.to_dict()
+        d[f.name] = v
+    return d
+
+
+def layer_from_dict(d: dict):
+    d = dict(d)
+    cls = LAYER_REGISTRY[d.pop("@class")]
+    names = {f.name for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for k, v in d.items():
+        if k not in names:
+            continue
+        if k == "updater" and isinstance(v, dict):
+            v = Updater.from_dict(v)
+        elif k == "dist" and isinstance(v, dict):
+            v = Distribution.from_dict(v)
+        kwargs[k] = v
+    return cls(**kwargs)
+
+
+def dropout_input(x, dropout: float, train: bool, rng):
+    """Inverted dropout on layer input (reference: Dropout.applyDropout via
+    BaseLayer.applyDropOutIfNecessary; retain-prob semantics of DL4J 0.9)."""
+    if not train or not dropout or dropout >= 1.0 or rng is None:
+        return x
+    keep = dropout
+    m = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(m, x / keep, 0.0).astype(x.dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class Layer:
+    """Base of all layer configs (reference nn/conf/layers/Layer.java)."""
+
+    name: Optional[str] = None
+    dropout: float = 0.0
+
+    # ---- shape inference ----
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    # ---- params ----
+    def init(self, rng, input_type: InputType, dtype=jnp.float32):
+        return {}, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        raise NotImplementedError
+
+    # which param keys get l1/l2 (weights only, like DL4J's regularization-by-param-type)
+    def regularizable(self) -> Tuple[str, ...]:
+        return ()
+
+    # keys whose params should NOT be updated when layer is frozen etc.
+    def has_params(self) -> bool:
+        return bool(self.regularizable()) or False
+
+    def is_output_layer(self) -> bool:
+        return False
+
+    def is_recurrent(self) -> bool:
+        return False
+
+    def input_kind(self) -> str:
+        """Preferred input family for automatic preprocessor insertion:
+        'ff' | 'cnn' | 'rnn' | 'any' (reference: each layer conf's
+        getPreProcessorForInputType)."""
+        return "any"
+
+    def with_n_in(self, n_in: int):
+        """Fill in n_in during config wiring (reference
+        MultiLayerConfiguration's preProcess/setNIn pass)."""
+        if hasattr(self, "n_in") and getattr(self, "n_in") in (None, 0):
+            return dataclasses.replace(self, n_in=n_in)
+        return self
+
+    def to_dict(self):
+        return layer_to_dict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class BaseLayer(Layer):
+    """Layers with weights (reference nn/conf/layers/BaseLayer.java): carry
+    activation, weight init, regularization and per-layer updater override."""
+
+    activation: str = "identity"
+    weight_init: str = "xavier"
+    dist: Optional[Distribution] = None
+    bias_init: float = 0.0
+    l1: float = 0.0
+    l2: float = 0.0
+    l1_bias: float = 0.0
+    l2_bias: float = 0.0
+    updater: Optional[Updater] = None
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: float = 1.0
+
+    def regularizable(self):
+        return ("W",)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class DenseLayer(BaseLayer):
+    """Fully connected layer (reference nn/conf/layers/DenseLayer.java +
+    nn/layers/feedforward/dense/DenseLayer.java). y = act(x @ W + b).
+
+    The matmul is MXU-shaped: (batch, n_in) @ (n_in, n_out)."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    has_bias: bool = True
+
+    def input_kind(self):
+        return "ff"
+
+    def output_type(self, input_type):
+        if input_type.kind == "rnn":  # dense broadcasts over time natively
+            return InputType.recurrent(self.n_out, input_type.timeseries_length)
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, rng, input_type, dtype=jnp.float32):
+        n_in = self.n_in or input_type.flat_size()
+        k_w, _ = jax.random.split(rng)
+        params = {
+            "W": init_weights(k_w, (n_in, self.n_out), n_in, self.n_out,
+                              self.weight_init, self.dist, dtype)
+        }
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = dropout_input(x, self.dropout, train, rng)
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        return get_activation(self.activation)(z), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class ActivationLayer(Layer):
+    """Pure activation (reference nn/conf/layers/ActivationLayer.java)."""
+
+    activation: str = "relu"
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return get_activation(self.activation)(x), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class DropoutLayer(Layer):
+    """Standalone dropout (reference nn/conf/layers/DropoutLayer.java).
+    ``dropout`` = retain probability."""
+
+    dropout: float = 0.5
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return dropout_input(x, self.dropout, train, rng), state
+
+
+@dataclasses.dataclass(frozen=True)
+class BaseOutputLayer(BaseLayer):
+    """Common machinery for loss-bearing layers (reference
+    nn/conf/layers/BaseOutputLayer.java + nn/layers/BaseOutputLayer.java).
+
+    ``apply`` returns post-activation predictions; ``pre_output`` returns the
+    pre-activation z used for the numerically-stable fused loss; ``score``
+    computes the mask-aware mean loss."""
+
+    loss: str = "mcxent"
+    loss_weights: Optional[Tuple[float, ...]] = None
+
+    def is_output_layer(self):
+        return True
+
+    def pre_output(self, params, x):
+        z = x @ params["W"]
+        if "b" in params:
+            z = z + params["b"]
+        return z
+
+    def compute_score(self, labels, preout, mask=None):
+        return lossfunctions.score(self.loss, labels, preout, self.activation,
+                                   mask, self.loss_weights)
+
+    def compute_score_array(self, labels, preout, mask=None):
+        return lossfunctions.score_array(self.loss, labels, preout,
+                                         self.activation, mask, self.loss_weights)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class OutputLayer(BaseOutputLayer):
+    """Dense + loss (reference nn/conf/layers/OutputLayer.java)."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    has_bias: bool = True
+    activation: str = "softmax"
+
+    def input_kind(self):
+        return "ff"
+
+    def output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, rng, input_type, dtype=jnp.float32):
+        n_in = self.n_in or input_type.flat_size()
+        k_w, _ = jax.random.split(rng)
+        params = {
+            "W": init_weights(k_w, (n_in, self.n_out), n_in, self.n_out,
+                              self.weight_init, self.dist, dtype)
+        }
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = dropout_input(x, self.dropout, train, rng)
+        return get_activation(self.activation)(self.pre_output(params, x)), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class LossLayer(BaseOutputLayer):
+    """Loss without weights (reference nn/conf/layers/LossLayer.java)."""
+
+    activation: str = "identity"
+
+    def regularizable(self):
+        return ()
+
+    def pre_output(self, params, x):
+        return x
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return get_activation(self.activation)(x), state

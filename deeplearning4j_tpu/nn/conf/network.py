@@ -1,0 +1,254 @@
+"""Network configuration: global defaults + MultiLayerConfiguration.
+
+Parity surface: reference ``nn/conf/NeuralNetConfiguration.java`` (Builder at
+:570, ``list()`` at :727) and ``nn/conf/MultiLayerConfiguration.java``
+(JSON round-trip via ``toJson``/``fromJson``; tBPTT config at :354-445).
+
+Global defaults (activation, weight init, l1/l2, updater, dropout, ...) set on
+the builder are applied to every layer that did not override them — the same
+clone-then-override mechanics as ``NeuralNetConfiguration.Builder`` but on
+frozen dataclasses: a layer field still equal to its dataclass default is
+treated as "unset" and inherits the global value.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import Layer, layer_from_dict
+from deeplearning4j_tpu.optimize.updaters import Updater, Sgd
+
+# fields a builder-level default may override on layers
+_GLOBAL_LAYER_FIELDS = (
+    "activation", "weight_init", "dist", "bias_init", "l1", "l2", "l1_bias",
+    "l2_bias", "updater", "dropout", "gradient_normalization",
+    "gradient_normalization_threshold",
+)
+
+
+def _apply_layer_defaults(layer: Layer, defaults: dict) -> Layer:
+    field_map = {f.name: f for f in dataclasses.fields(layer)}
+    updates = {}
+    for k, v in defaults.items():
+        if k not in field_map or v is None:
+            continue
+        f = field_map[k]
+        cur = getattr(layer, k)
+        default_val = f.default if f.default is not dataclasses.MISSING else None
+        if cur == default_val:
+            updates[k] = v
+    return dataclasses.replace(layer, **updates) if updates else layer
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiLayerConfiguration:
+    """Immutable, JSON-round-trippable network config (reference
+    nn/conf/MultiLayerConfiguration.java)."""
+
+    layers: Tuple[Layer, ...]
+    input_type: Optional[InputType] = None
+    seed: int = 12345
+    dtype: str = "float32"
+    updater: Updater = Sgd(learning_rate=0.1)  # global default updater
+    backprop_type: str = "standard"  # "standard" | "tbptt"
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
+    # per-layer-index input preprocessors (reference InputPreProcessor map)
+    input_preprocessors: Optional[Dict[int, object]] = None
+
+    # ---- shape wiring (reference MultiLayerConfiguration getLayerActivationTypes) ----
+    def layer_input_types(self) -> List[InputType]:
+        """Input type *seen by each layer* after preprocessor insertion."""
+        from deeplearning4j_tpu.nn.conf.preprocessors import infer_preprocessor
+        if self.input_type is None:
+            raise ValueError("MultiLayerConfiguration requires input_type for shape inference")
+        types = []
+        cur = self.input_type
+        for i, layer in enumerate(self.layers):
+            pre = (self.input_preprocessors or {}).get(i)
+            if pre is None:
+                pre = infer_preprocessor(cur, layer)
+            if pre is not None:
+                cur = pre.output_type(cur)
+            types.append(cur)
+            cur = layer.output_type(cur)
+        return types
+
+    def wired_layers(self) -> Tuple[Layer, ...]:
+        """Layers with n_in filled from shape inference."""
+        types = self.layer_input_types()
+        return tuple(l.with_n_in(t.flat_size()) for l, t in zip(self.layers, types))
+
+    def resolved_preprocessors(self):
+        from deeplearning4j_tpu.nn.conf.preprocessors import infer_preprocessor
+        out = {}
+        cur = self.input_type
+        for i, layer in enumerate(self.layers):
+            pre = (self.input_preprocessors or {}).get(i)
+            if pre is None and cur is not None:
+                pre = infer_preprocessor(cur, layer)
+            if pre is not None:
+                out[i] = pre
+                cur = pre.output_type(cur)
+            cur = layer.output_type(cur) if cur is not None else None
+        return out
+
+    # ---- serde (reference toJson/fromJson) ----
+    def to_json(self) -> str:
+        from deeplearning4j_tpu.nn.conf.preprocessors import preprocessor_to_dict
+        d = {
+            "layers": [l.to_dict() for l in self.layers],
+            "seed": self.seed,
+            "dtype": self.dtype,
+            "updater": self.updater.to_dict(),
+            "backprop_type": self.backprop_type,
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_back_length": self.tbptt_back_length,
+        }
+        if self.input_type is not None:
+            d["input_type"] = self.input_type.to_dict()
+        if self.input_preprocessors:
+            d["input_preprocessors"] = {
+                str(k): preprocessor_to_dict(v) for k, v in self.input_preprocessors.items()}
+        return json.dumps(d, indent=2)
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        from deeplearning4j_tpu.nn.conf.preprocessors import preprocessor_from_dict
+        d = json.loads(s)
+        pre = None
+        if "input_preprocessors" in d:
+            pre = {int(k): preprocessor_from_dict(v)
+                   for k, v in d["input_preprocessors"].items()}
+        return MultiLayerConfiguration(
+            layers=tuple(layer_from_dict(ld) for ld in d["layers"]),
+            input_type=InputType.from_dict(d["input_type"]) if "input_type" in d else None,
+            seed=d.get("seed", 12345),
+            dtype=d.get("dtype", "float32"),
+            updater=Updater.from_dict(d["updater"]),
+            backprop_type=d.get("backprop_type", "standard"),
+            tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
+            tbptt_back_length=d.get("tbptt_back_length", 20),
+            input_preprocessors=pre,
+        )
+
+
+class NeuralNetConfiguration:
+    """Fluent builder entry point (reference NeuralNetConfiguration.Builder).
+
+    Example::
+
+        conf = (NeuralNetConfiguration.builder()
+                .seed(42).updater(Adam(1e-3)).weight_init("xavier")
+                .list()
+                .layer(DenseLayer(n_out=64, activation="relu"))
+                .layer(OutputLayer(n_out=10, loss="mcxent"))
+                .set_input_type(InputType.feed_forward(784))
+                .build())
+    """
+
+    @staticmethod
+    def builder() -> "Builder":
+        return Builder()
+
+
+class Builder:
+    def __init__(self):
+        self._defaults: dict = {}
+        self._seed = 12345
+        self._dtype = "float32"
+        self._updater: Updater = Sgd(learning_rate=0.1)
+
+    def seed(self, s: int) -> "Builder":
+        self._seed = int(s)
+        return self
+
+    def dtype(self, dt: str) -> "Builder":
+        self._dtype = dt
+        return self
+
+    def updater(self, u: Updater) -> "Builder":
+        self._updater = u
+        self._defaults["updater"] = u
+        return self
+
+    def weight_init(self, wi: str, dist=None) -> "Builder":
+        self._defaults["weight_init"] = wi
+        if dist is not None:
+            self._defaults["dist"] = dist
+        return self
+
+    def activation(self, a: str) -> "Builder":
+        self._defaults["activation"] = a
+        return self
+
+    def l1(self, v: float) -> "Builder":
+        self._defaults["l1"] = v
+        return self
+
+    def l2(self, v: float) -> "Builder":
+        self._defaults["l2"] = v
+        return self
+
+    def dropout(self, keep_prob: float) -> "Builder":
+        self._defaults["dropout"] = keep_prob
+        return self
+
+    def bias_init(self, v: float) -> "Builder":
+        self._defaults["bias_init"] = v
+        return self
+
+    def gradient_normalization(self, kind: str, threshold: float = 1.0) -> "Builder":
+        self._defaults["gradient_normalization"] = kind
+        self._defaults["gradient_normalization_threshold"] = threshold
+        return self
+
+    def list(self) -> "ListBuilder":
+        return ListBuilder(self)
+
+
+class ListBuilder:
+    """reference NeuralNetConfiguration.ListBuilder (list() at :727)."""
+
+    def __init__(self, parent: Builder):
+        self._parent = parent
+        self._layers: List[Layer] = []
+        self._input_type: Optional[InputType] = None
+        self._backprop_type = "standard"
+        self._tbptt_fwd = 20
+        self._tbptt_back = 20
+        self._preprocessors: Dict[int, object] = {}
+
+    def layer(self, conf: Layer) -> "ListBuilder":
+        self._layers.append(_apply_layer_defaults(conf, self._parent._defaults))
+        return self
+
+    def set_input_type(self, it: InputType) -> "ListBuilder":
+        self._input_type = it
+        return self
+
+    def input_preprocessor(self, idx: int, pre) -> "ListBuilder":
+        self._preprocessors[idx] = pre
+        return self
+
+    def backprop_type(self, t: str, fwd_length: int = 20, back_length: int = 20) -> "ListBuilder":
+        self._backprop_type = t
+        self._tbptt_fwd = fwd_length
+        self._tbptt_back = back_length
+        return self
+
+    def build(self) -> MultiLayerConfiguration:
+        return MultiLayerConfiguration(
+            layers=tuple(self._layers),
+            input_type=self._input_type,
+            seed=self._parent._seed,
+            dtype=self._parent._dtype,
+            updater=self._parent._updater,
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_fwd,
+            tbptt_back_length=self._tbptt_back,
+            input_preprocessors=self._preprocessors or None,
+        )

@@ -1,0 +1,175 @@
+"""Input preprocessors — shape adapters between layer families.
+
+Parity surface: reference ``nn/conf/preprocessor/`` (CnnToFeedForward,
+FeedForwardToCnn, RnnToFeedForward, FeedForwardToRnn, RnnToCnn, CnnToRnn, ...)
+and the automatic insertion logic in
+``MultiLayerConfiguration`` / ``InputType`` wiring.
+
+TPU layouts: CNN activations are NHWC; RNN activations (batch, time, size).
+All adapters are static reshapes/transposes, free under XLA (layout ops fuse).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+_PRE_REGISTRY = {}
+
+
+def register_preprocessor(cls):
+    _PRE_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def preprocessor_to_dict(p):
+    d = dataclasses.asdict(p)
+    d["@class"] = type(p).__name__
+    return d
+
+
+def preprocessor_from_dict(d):
+    d = dict(d)
+    cls = _PRE_REGISTRY[d.pop("@class")]
+    return cls(**d)
+
+
+@register_preprocessor
+@dataclasses.dataclass(frozen=True)
+class CnnToFeedForwardPreProcessor:
+    """NHWC -> flat (reference nn/conf/preprocessor/CnnToFeedForwardPreProcessor.java)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feed_forward(it.flat_size())
+
+    def apply(self, x, mask=None):
+        return x.reshape(x.shape[0], -1), mask
+
+    def backward_shape(self, it: InputType):
+        return it
+
+
+@register_preprocessor
+@dataclasses.dataclass(frozen=True)
+class FeedForwardToCnnPreProcessor:
+    """flat -> NHWC (reference FeedForwardToCnnPreProcessor.java)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+    def apply(self, x, mask=None):
+        return x.reshape(x.shape[0], self.height, self.width, self.channels), mask
+
+
+@register_preprocessor
+@dataclasses.dataclass(frozen=True)
+class RnnToFeedForwardPreProcessor:
+    """(batch, time, size) -> (batch*time, size) (reference
+    RnnToFeedForwardPreProcessor.java). The per-timestep mask flattens with it."""
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feed_forward(it.size)
+
+    def apply(self, x, mask=None):
+        b, t, s = x.shape
+        out = x.reshape(b * t, s)
+        if mask is not None:
+            mask = mask.reshape(b * t)
+        return out, mask
+
+
+@register_preprocessor
+@dataclasses.dataclass(frozen=True)
+class FeedForwardToRnnPreProcessor:
+    """(batch*time, size) -> (batch, time, size) (reference
+    FeedForwardToRnnPreProcessor.java). Needs the time length captured at
+    trace time; the network threads it through."""
+
+    timeseries_length: int = 0
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(it.flat_size(), self.timeseries_length or None)
+
+    def apply(self, x, mask=None):
+        t = self.timeseries_length
+        out = x.reshape(-1, t, x.shape[-1])
+        if mask is not None:
+            mask = mask.reshape(-1, t)
+        return out, mask
+
+
+@register_preprocessor
+@dataclasses.dataclass(frozen=True)
+class RnnToCnnPreProcessor:
+    """(batch, time, h*w*c) -> (batch*time, h, w, c) (reference RnnToCnnPreProcessor.java)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+    def apply(self, x, mask=None):
+        b, t, _ = x.shape
+        out = x.reshape(b * t, self.height, self.width, self.channels)
+        if mask is not None:
+            mask = mask.reshape(b * t)
+        return out, mask
+
+
+@register_preprocessor
+@dataclasses.dataclass(frozen=True)
+class CnnToRnnPreProcessor:
+    """(batch*time, h, w, c) -> (batch, time, h*w*c) (reference CnnToRnnPreProcessor.java)."""
+
+    timeseries_length: int = 0
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(it.flat_size(), self.timeseries_length or None)
+
+    def apply(self, x, mask=None):
+        t = self.timeseries_length
+        flat = x.reshape(x.shape[0], -1)
+        out = flat.reshape(-1, t, flat.shape[-1])
+        if mask is not None:
+            mask = mask.reshape(-1, t)
+        return out, mask
+
+
+def infer_preprocessor(cur: InputType, layer):
+    """Automatic adapter insertion (reference: the InputType-driven
+    getPreProcessorForInputType logic each layer conf implements)."""
+    want = layer.input_kind() if hasattr(layer, "input_kind") else "any"
+    if want == "any" or cur is None:
+        return None
+    if want == "ff":
+        if cur.kind == "cnn":
+            return CnnToFeedForwardPreProcessor(cur.height, cur.width, cur.channels)
+        if cur.kind == "rnn":
+            return None  # dense layers broadcast over time natively (x @ W)
+        return None
+    if want == "cnn":
+        if cur.kind in ("cnn_flat", "ff"):
+            if cur.kind == "cnn_flat":
+                return FeedForwardToCnnPreProcessor(cur.height, cur.width, cur.channels)
+            raise ValueError(
+                "Cannot infer CNN shape from plain feed-forward input; use "
+                "InputType.convolutional_flat or an explicit FeedForwardToCnnPreProcessor")
+        return None
+    if want == "rnn":
+        if cur.kind == "ff":
+            return None
+        return None
+    return None

@@ -1,0 +1,124 @@
+"""Weight initialization schemes and init distributions.
+
+Parity surface: reference ``nn/weights/WeightInit.java`` + ``WeightInitUtil.java``
+and the distribution configs in ``nn/conf/distribution/`` (Normal, Uniform,
+TruncatedNormal, Orthogonal, Binomial, LogNormal, Constant).
+
+DL4J computes fan-in/fan-out from the weight-view shape
+(WeightInitUtil.initWeights); here each layer passes explicit (fan_in, fan_out)
+so conv and dense share one code path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Distribution:
+    """Init distribution (reference nn/conf/distribution/Distribution.java)."""
+
+    kind: str = "normal"  # normal|uniform|truncated_normal|log_normal|orthogonal|binomial|constant
+    mean: float = 0.0
+    std: float = 1.0
+    lower: float = -1.0
+    upper: float = 1.0
+    gain: float = 1.0
+    n_trials: int = 1
+    p_success: float = 0.5
+    value: float = 0.0
+
+    def sample(self, rng, shape, dtype=jnp.float32):
+        k = self.kind
+        if k == "normal":
+            return self.mean + self.std * jax.random.normal(rng, shape, dtype)
+        if k == "truncated_normal":
+            return self.mean + self.std * jax.random.truncated_normal(rng, -2.0, 2.0, shape, dtype)
+        if k == "log_normal":
+            return jnp.exp(self.mean + self.std * jax.random.normal(rng, shape, dtype))
+        if k == "uniform":
+            return jax.random.uniform(rng, shape, dtype, self.lower, self.upper)
+        if k == "orthogonal":
+            return self.gain * jax.nn.initializers.orthogonal()(rng, shape, dtype)
+        if k == "binomial":
+            return jax.random.binomial(rng, self.n_trials, self.p_success, shape).astype(dtype)
+        if k == "constant":
+            return jnp.full(shape, self.value, dtype)
+        raise ValueError(f"Unknown distribution kind '{k}'")
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d):
+        return Distribution(**d)
+
+
+def init_weights(
+    rng,
+    shape,
+    fan_in: float,
+    fan_out: float,
+    weight_init: str = "xavier",
+    distribution: Optional[Distribution] = None,
+    dtype=jnp.float32,
+):
+    """Initialize a weight tensor (reference WeightInitUtil.initWeights).
+
+    Scheme names follow WeightInit.java. DL4J's XAVIER is
+    gaussian with var = 2/(fan_in+fan_out); RELU is He/MSRA.
+    """
+    wi = str(weight_init).lower()
+    n = jax.random.normal
+    u = jax.random.uniform
+    if wi == "distribution":
+        if distribution is None:
+            raise ValueError("weight_init='distribution' requires a Distribution")
+        return distribution.sample(rng, shape, dtype)
+    if wi == "zero":
+        return jnp.zeros(shape, dtype)
+    if wi == "ones":
+        return jnp.ones(shape, dtype)
+    if wi == "normal":  # N(0, 1/sqrt(fan_in))
+        return n(rng, shape, dtype) / jnp.sqrt(fan_in)
+    if wi == "xavier":
+        return n(rng, shape, dtype) * jnp.sqrt(2.0 / (fan_in + fan_out))
+    if wi == "xavier_uniform":
+        s = jnp.sqrt(6.0 / (fan_in + fan_out))
+        return u(rng, shape, dtype, -s, s)
+    if wi == "xavier_fan_in":
+        return n(rng, shape, dtype) / jnp.sqrt(fan_in)
+    if wi == "xavier_legacy":
+        return n(rng, shape, dtype) * jnp.sqrt(1.0 / (fan_in + fan_out))
+    if wi == "relu":  # He normal
+        return n(rng, shape, dtype) * jnp.sqrt(2.0 / fan_in)
+    if wi == "relu_uniform":
+        s = jnp.sqrt(6.0 / fan_in)
+        return u(rng, shape, dtype, -s, s)
+    if wi == "sigmoid_uniform":
+        s = 4.0 * jnp.sqrt(6.0 / (fan_in + fan_out))
+        return u(rng, shape, dtype, -s, s)
+    if wi == "uniform":  # U(-a, a), a = 1/sqrt(fan_in)
+        s = 1.0 / jnp.sqrt(fan_in)
+        return u(rng, shape, dtype, -s, s)
+    if wi == "lecun_normal":
+        return n(rng, shape, dtype) * jnp.sqrt(1.0 / fan_in)
+    if wi == "lecun_uniform":
+        s = jnp.sqrt(3.0 / fan_in)
+        return u(rng, shape, dtype, -s, s)
+    if wi == "identity":
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("IDENTITY weight init requires a square 2-D shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    if wi in ("var_scaling_normal_fan_in", "var_scaling_normal_fan_out", "var_scaling_normal_fan_avg"):
+        fan = {"in": fan_in, "out": fan_out, "avg": 0.5 * (fan_in + fan_out)}[wi.rsplit("_", 1)[-1]]
+        return n(rng, shape, dtype) * jnp.sqrt(1.0 / fan)
+    if wi in ("var_scaling_uniform_fan_in", "var_scaling_uniform_fan_out", "var_scaling_uniform_fan_avg"):
+        fan = {"in": fan_in, "out": fan_out, "avg": 0.5 * (fan_in + fan_out)}[wi.rsplit("_", 1)[-1]]
+        s = jnp.sqrt(3.0 / fan)
+        return u(rng, shape, dtype, -s, s)
+    raise ValueError(f"Unknown weight init '{weight_init}'")

@@ -1,0 +1,180 @@
+"""Loss functions.
+
+Parity surface: ND4J ``org.nd4j.linalg.lossfunctions.LossFunctions`` (external
+dependency of the reference; used by every output layer config, e.g.
+deeplearning4j-nn/.../nn/conf/layers/OutputLayer.java). Losses are computed from
+the *pre-activation* output plus the activation name so that softmax+MCXENT and
+sigmoid+XENT use numerically-stable fused forms; the backward pass is autodiff.
+
+Conventions:
+- ``labels``/``preout`` are (batch, n_out) or (batch, time, n_out) for RNNs.
+- ``mask`` is optional (batch,) or (batch, time); masked scores are excluded
+  from the average (reference: per-example score arrays + mask handling in
+  BaseOutputLayer/LossFunction scoreArray implementations).
+- ``weights`` is an optional per-output weight vector (ND4J loss weights).
+- Each loss returns the per-example score array; ``score_from_array`` reduces
+  to the mean the way DL4J's computeScore does (sum over outputs, mean over
+  examples/timesteps).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+
+_EPS = 1e-7
+
+
+def _apply_act(preout, activation):
+    return get_activation(activation)(preout)
+
+
+def _weighted(arr, weights):
+    if weights is None:
+        return arr
+    return arr * jnp.asarray(weights, arr.dtype)
+
+
+def _score_mse(labels, preout, activation, weights):
+    d = _apply_act(preout, activation) - labels
+    return _weighted(d * d, weights)
+
+
+def _score_l2(labels, preout, activation, weights):
+    return _score_mse(labels, preout, activation, weights)
+
+
+def _score_l1(labels, preout, activation, weights):
+    return _weighted(jnp.abs(_apply_act(preout, activation) - labels), weights)
+
+
+def _score_mcxent(labels, preout, activation, weights):
+    act = str(activation).lower()
+    if act == "softmax":
+        logp = jax.nn.log_softmax(preout, axis=-1)
+    else:
+        out = jnp.clip(_apply_act(preout, activation), _EPS, 1.0 - _EPS)
+        logp = jnp.log(out)
+    return _weighted(-labels * logp, weights)
+
+
+def _score_xent(labels, preout, activation, weights):
+    # Binary cross-entropy, stable for sigmoid activation.
+    act = str(activation).lower()
+    if act == "sigmoid":
+        # log(sigmoid(x)) = -softplus(-x); log(1-sigmoid(x)) = -softplus(x)
+        s = -(labels * -jax.nn.softplus(-preout) + (1.0 - labels) * -jax.nn.softplus(preout))
+    else:
+        out = jnp.clip(_apply_act(preout, activation), _EPS, 1.0 - _EPS)
+        s = -(labels * jnp.log(out) + (1.0 - labels) * jnp.log(1.0 - out))
+    return _weighted(s, weights)
+
+
+def _score_nll(labels, preout, activation, weights):
+    return _score_mcxent(labels, preout, activation, weights)
+
+
+def _score_kld(labels, preout, activation, weights):
+    out = jnp.clip(_apply_act(preout, activation), _EPS, 1.0)
+    lab = jnp.clip(labels, _EPS, 1.0)
+    return _weighted(labels * (jnp.log(lab) - jnp.log(out)), weights)
+
+
+def _score_poisson(labels, preout, activation, weights):
+    out = jnp.clip(_apply_act(preout, activation), _EPS, None)
+    return _weighted(out - labels * jnp.log(out), weights)
+
+
+def _score_cosine(labels, preout, activation, weights):
+    out = _apply_act(preout, activation)
+    dot = jnp.sum(out * labels, axis=-1, keepdims=True)
+    no = jnp.maximum(jnp.linalg.norm(out, axis=-1, keepdims=True), _EPS)
+    nl = jnp.maximum(jnp.linalg.norm(labels, axis=-1, keepdims=True), _EPS)
+    sim = dot / (no * nl)
+    # per-example score spread across one column (sum-over-outputs reduces it back)
+    return _weighted(jnp.broadcast_to((1.0 - sim) / labels.shape[-1], labels.shape), weights)
+
+
+def _score_hinge(labels, preout, activation, weights):
+    # labels in {-1, +1} (or {0,1} mapped)
+    y = jnp.where(labels > 0, 1.0, -1.0)
+    out = _apply_act(preout, activation)
+    return _weighted(jnp.maximum(0.0, 1.0 - y * out), weights)
+
+
+def _score_squared_hinge(labels, preout, activation, weights):
+    h = _score_hinge(labels, preout, activation, None)
+    return _weighted(h * h, weights)
+
+
+def _score_mape(labels, preout, activation, weights):
+    out = _apply_act(preout, activation)
+    return _weighted(100.0 * jnp.abs((labels - out) / jnp.clip(jnp.abs(labels), _EPS, None)), weights)
+
+
+def _score_msle(labels, preout, activation, weights):
+    out = _apply_act(preout, activation)
+    d = jnp.log1p(jnp.clip(out, -1 + _EPS, None)) - jnp.log1p(jnp.clip(labels, -1 + _EPS, None))
+    return _weighted(d * d, weights)
+
+
+LOSSES = {
+    "mse": _score_mse,
+    "l2": _score_l2,
+    "l1": _score_l1,
+    "mae": _score_l1,
+    "mcxent": _score_mcxent,
+    "xent": _score_xent,
+    "negativeloglikelihood": _score_nll,
+    "nll": _score_nll,
+    "kl_divergence": _score_kld,
+    "kld": _score_kld,
+    "reconstruction_crossentropy": _score_xent,
+    "poisson": _score_poisson,
+    "cosine_proximity": _score_cosine,
+    "hinge": _score_hinge,
+    "squared_hinge": _score_squared_hinge,
+    "mean_absolute_percentage_error": _score_mape,
+    "mape": _score_mape,
+    "mean_squared_logarithmic_error": _score_msle,
+    "msle": _score_msle,
+}
+
+
+def get_loss(name):
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in LOSSES:
+        raise ValueError(f"Unknown loss '{name}'. Known: {sorted(LOSSES)}")
+    return LOSSES[key]
+
+
+def score_array(loss, labels, preout, activation="identity", mask=None, weights=None):
+    """Per-example score: sum over the output dim, masked.
+
+    Returns shape (batch,) or (batch, time).
+    """
+    fn = get_loss(loss)
+    s = fn(labels, preout, activation, weights)
+    s = jnp.sum(s, axis=-1)
+    if mask is not None:
+        s = s * mask
+    return s
+
+
+def score(loss, labels, preout, activation="identity", mask=None, weights=None):
+    """Scalar score: mean over (unmasked) examples/timesteps.
+
+    Matches DL4J computeScore: sum of per-example scores / number of counted
+    examples (mask-aware).
+    """
+    s = score_array(loss, labels, preout, activation, mask, weights)
+    if mask is not None:
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+    else:
+        denom = float(s.size) / float(s.shape[0]) * s.shape[0]  # == s.size
+        denom = jnp.asarray(denom, s.dtype)
+    return jnp.sum(s) / denom

@@ -1,0 +1,298 @@
+"""MultiLayerNetwork — the sequential-stack network façade.
+
+Parity surface: reference
+deeplearning4j-nn/.../nn/multilayer/MultiLayerNetwork.java:90 (class), :541
+(init), :852-964 (feedForward), :1156 (fit(DataSetIterator)), :1267 (backprop),
+:2206 (computeGradientAndScore), :1947 (output).
+
+TPU-native design: everything between ``setInput`` and the optimizer step —
+forward, loss, backward, updater — is ONE jit-compiled XLA program
+(``_train_step``) executed per minibatch, with buffer donation for params /
+optimizer state (replacing ND4J workspaces). The Java-side per-layer
+interpretive loop and the Solver/StepFunction machinery dissolve into the
+traced program; listeners and iterators remain host-side, as in the reference.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.layers import dropout_input
+from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+from deeplearning4j_tpu.optimize.updaters import gradient_normalization
+import optax
+
+
+def _compute_dtype(name: str):
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+            "float16": jnp.float16, "float64": jnp.float64}[name]
+
+
+class MultiLayerNetwork:
+    """Sequential network with fit/output/score (see module docstring)."""
+
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers = conf.wired_layers()
+        self._pre = conf.resolved_preprocessors()
+        if not self.layers:
+            raise ValueError("Empty layer list")
+        self._dtype = _compute_dtype(conf.dtype)
+        # per-layer optax transforms (reference BaseMultiLayerUpdater blocks)
+        self._txs = [
+            (l.updater if getattr(l, "updater", None) is not None else conf.updater).to_optax()
+            if (l.regularizable() or self._layer_has_params(l)) else optax.set_to_zero()
+            for l in self.layers
+        ]
+        self._gnorms = [
+            gradient_normalization(getattr(l, "gradient_normalization", None),
+                                   getattr(l, "gradient_normalization_threshold", 1.0))
+            for l in self.layers
+        ]
+        self.params: Optional[List[dict]] = None
+        self.state: Optional[List[dict]] = None
+        self.opt_state: Optional[list] = None
+        self.listeners: list = []
+        self.iteration = 0
+        self.epoch = 0
+        self.last_batch_size: Optional[int] = None
+        self._score: Optional[float] = None
+        self._rng = None
+        self._jit_cache = {}
+
+    # ------------------------------------------------------------------ init
+    @staticmethod
+    def _layer_has_params(layer) -> bool:
+        return bool(layer.regularizable())
+
+    def init(self, seed: Optional[int] = None) -> "MultiLayerNetwork":
+        """Initialize params/optimizer state (reference MultiLayerNetwork.init :541)."""
+        rng = jax.random.key(self.conf.seed if seed is None else seed)
+        types = self.conf.layer_input_types()
+        params, state = [], []
+        for layer, it in zip(self.layers, types):
+            rng, k = jax.random.split(rng)
+            p, s = layer.init(k, it, jnp.float32)  # master params in f32
+            params.append(p)
+            state.append(s)
+        self.params = params
+        self.state = state
+        self.opt_state = [tx.init(p) for tx, p in zip(self._txs, params)]
+        self._rng = rng
+        return self
+
+    def num_params(self) -> int:
+        if self.params is None:
+            return 0
+        return sum(int(np.prod(a.shape)) for a in jax.tree_util.tree_leaves(self.params))
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def add_listener(self, listener):
+        self.listeners.append(listener)
+
+    def score(self) -> Optional[float]:
+        """Most recent minibatch score (reference Model.score())."""
+        return None if self._score is None else float(self._score)
+
+    # --------------------------------------------------------------- forward
+    def _forward(self, params, state, x, train: bool, rng, fmask):
+        """Full forward pass; returns (activations list, preout of output
+        layer, new_state, final mask). Traced by jit — the reference's
+        feedForwardToLayer loop unrolls into one XLA graph."""
+        acts = []
+        new_state = []
+        preout = None
+        cur_mask = fmask
+        cdt = self._dtype
+        if cdt != jnp.float32:
+            x = x.astype(cdt)
+            params = jax.tree_util.tree_map(lambda a: a.astype(cdt), params)
+        n = len(self.layers)
+        for i, layer in enumerate(self.layers):
+            if i in self._pre:
+                x, cur_mask = self._pre[i].apply(x, cur_mask)
+            k = None
+            if rng is not None:
+                rng, k = jax.random.split(rng)
+            if i == n - 1 and layer.is_output_layer():
+                x_in = dropout_input(x, layer.dropout, train, k)
+                preout = layer.pre_output(params[i], x_in).astype(jnp.float32)
+                x = get_activation(layer.activation)(preout)
+                new_state.append(state[i])
+            else:
+                x, st = layer.apply(params[i], state[i], x, train=train, rng=k, mask=cur_mask)
+                new_state.append(st)
+            acts.append(x)
+        return acts, preout, new_state, cur_mask
+
+    def _regularization(self, params):
+        """L1/L2 penalty (reference BaseLayer.calcL2/calcL1; score term added in
+        BaseOutputLayer.computeScore fullNetworkL1/L2)."""
+        total = 0.0
+        for layer, p in zip(self.layers, params):
+            l1 = getattr(layer, "l1", 0.0) or 0.0
+            l2 = getattr(layer, "l2", 0.0) or 0.0
+            l1b = getattr(layer, "l1_bias", 0.0) or 0.0
+            l2b = getattr(layer, "l2_bias", 0.0) or 0.0
+            for key in layer.regularizable():
+                if key in p:
+                    w = p[key].astype(jnp.float32)
+                    if l2:
+                        total = total + 0.5 * l2 * jnp.sum(w * w)
+                    if l1:
+                        total = total + l1 * jnp.sum(jnp.abs(w))
+            if (l1b or l2b) and "b" in p:
+                b = p["b"].astype(jnp.float32)
+                if l2b:
+                    total = total + 0.5 * l2b * jnp.sum(b * b)
+                if l1b:
+                    total = total + l1b * jnp.sum(jnp.abs(b))
+        return total
+
+    # ------------------------------------------------------------ train step
+    def _loss_fn(self, params, state, x, y, rng, fmask, lmask):
+        out_layer = self.layers[-1]
+        if not out_layer.is_output_layer():
+            raise ValueError("Last layer must be an output/loss layer to fit()")
+        acts, preout, new_state, cur_mask = self._forward(params, state, x, True, rng, fmask)
+        lm = lmask if lmask is not None else (cur_mask if cur_mask is not None else None)
+        loss = out_layer.compute_score(y.astype(jnp.float32), preout, lm)
+        loss = loss + self._regularization(params)
+        return loss, new_state
+
+    def _make_train_step(self):
+        value_and_grad = jax.value_and_grad(self._loss_fn, has_aux=True)
+
+        def step(params, state, opt_state, rng, x, y, fmask, lmask):
+            (loss, new_state), grads = value_and_grad(params, state, x, y, rng, fmask, lmask)
+            new_params = []
+            new_opt = []
+            for i, tx in enumerate(self._txs):
+                g = self._gnorms[i](grads[i])
+                updates, os = tx.update(g, opt_state[i], params[i])
+                new_params.append(optax.apply_updates(params[i], updates))
+                new_opt.append(os)
+            return new_params, new_state, new_opt, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _get_jitted(self, kind, key=()):
+        k = (kind,) + tuple(key)
+        fn = self._jit_cache.get(k)
+        if fn is None:
+            if kind == "train":
+                fn = self._make_train_step()
+            elif kind == "output":
+                fn = jax.jit(lambda params, state, x, fmask:
+                             self._forward(params, state, x, False, None, fmask)[0][-1])
+            elif kind == "score":
+                def score_fn(params, state, x, y, fmask, lmask):
+                    _, preout, _, cur_mask = self._forward(params, state, x, False, None, fmask)
+                    lm = lmask if lmask is not None else cur_mask
+                    return (self.layers[-1].compute_score(y.astype(jnp.float32), preout, lm)
+                            + self._regularization(params))
+                fn = jax.jit(score_fn)
+            else:
+                raise KeyError(kind)
+            self._jit_cache[k] = fn
+        return fn
+
+    # ------------------------------------------------------------------- fit
+    def fit(self, data, labels=None, num_epochs: int = 1):
+        """Train (reference MultiLayerNetwork.fit(DataSetIterator) :1156 and
+        fit(INDArray, INDArray)). ``data`` may be a DataSetIterator-like
+        iterable of DataSets, a DataSet, or a features array with ``labels``."""
+        if self.params is None:
+            self.init()
+        if labels is not None:
+            data = [DataSet(np.asarray(data), np.asarray(labels))]
+        elif isinstance(data, DataSet):
+            data = [data]
+        train_step = self._get_jitted("train")
+        for _ in range(num_epochs):
+            for listener in self.listeners:
+                listener.on_epoch_start(self)
+            for ds in data:
+                self._fit_batch(train_step, ds)
+            for listener in self.listeners:
+                listener.on_epoch_end(self)
+            self.epoch += 1
+        return self
+
+    def _fit_batch(self, train_step, ds: DataSet):
+        self._rng, k = jax.random.split(self._rng)
+        x = jnp.asarray(ds.features)
+        y = jnp.asarray(ds.labels)
+        fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+        lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        self.params, self.state, self.opt_state, loss = train_step(
+            self.params, self.state, self.opt_state, k, x, y, fm, lm)
+        self._score = loss
+        self.last_batch_size = int(x.shape[0])
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration, self.epoch)
+        self.iteration += 1
+
+    # ---------------------------------------------------------------- output
+    def output(self, x, train: bool = False) -> np.ndarray:
+        """Inference forward pass (reference MultiLayerNetwork.output :1947)."""
+        if self.params is None:
+            self.init()
+        fn = self._get_jitted("output")
+        return np.asarray(fn(self.params, self.state, jnp.asarray(x), None))
+
+    def predict(self, x) -> np.ndarray:
+        """Class indices (reference MultiLayerNetwork.predict)."""
+        return np.argmax(self.output(x), axis=-1)
+
+    def feed_forward(self, x, train: bool = False):
+        """All layer activations (reference feedForward :852)."""
+        acts, _, _, _ = self._forward(self.params, self.state, jnp.asarray(x),
+                                      train, None, None)
+        return [np.asarray(a) for a in acts]
+
+    def score_dataset(self, ds: DataSet) -> float:
+        """Loss on a dataset (reference MultiLayerNetwork.score(DataSet))."""
+        fn = self._get_jitted("score")
+        fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+        lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        return float(fn(self.params, self.state, jnp.asarray(ds.features),
+                        jnp.asarray(ds.labels), fm, lm))
+
+    def evaluate(self, iterator):
+        """Classification evaluation over an iterator (reference
+        MultiLayerNetwork.evaluate)."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        e = Evaluation()
+        for ds in iterator:
+            out = self.output(ds.features)
+            e.eval(ds.labels, out, mask=ds.labels_mask)
+        return e
+
+    def evaluate_regression(self, iterator):
+        from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+        e = RegressionEvaluation()
+        for ds in iterator:
+            out = self.output(ds.features)
+            e.eval(ds.labels, out, mask=ds.labels_mask)
+        return e
+
+    # ------------------------------------------------------------- utilities
+    def clone(self) -> "MultiLayerNetwork":
+        other = MultiLayerNetwork(self.conf)
+        if self.params is not None:
+            other.params = jax.tree_util.tree_map(lambda a: a, self.params)
+            other.state = jax.tree_util.tree_map(lambda a: a, self.state)
+            other.opt_state = jax.tree_util.tree_map(lambda a: a, self.opt_state)
+            other._rng = self._rng
+        return other

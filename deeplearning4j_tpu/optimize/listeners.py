@@ -1,0 +1,111 @@
+"""Training listeners.
+
+Parity surface: reference ``optimize/api/IterationListener.java`` /
+``TrainingListener.java`` and ``optimize/listeners/``:
+ScoreIterationListener, PerformanceListener (samples/sec —
+PerformanceListener.java:19-23), CollectScoresIterationListener,
+TimeIterationListener, EvaluativeListener (in eval module).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+
+class TrainingListener:
+    """Hook interface (reference TrainingListener.java)."""
+
+    def iteration_done(self, model, iteration: int, epoch: int):
+        pass
+
+    def on_epoch_start(self, model):
+        pass
+
+    def on_epoch_end(self, model):
+        pass
+
+    def on_forward_pass(self, model, activations):
+        pass
+
+    def on_gradient_calculation(self, model):
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Log score every N iterations (reference ScoreIterationListener.java)."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.print_iterations = max(1, print_iterations)
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.print_iterations == 0:
+            log.info("Score at iteration %d is %s", iteration, model.score())
+
+
+class PerformanceListener(TrainingListener):
+    """Throughput reporting (reference PerformanceListener.java:19-23):
+    samples/sec, batches/sec, iteration time. Feeds BASELINE measurements."""
+
+    def __init__(self, frequency: int = 1, report_score: bool = False):
+        self.frequency = max(1, frequency)
+        self.report_score = report_score
+        self._last_time: Optional[float] = None
+        self.samples_per_sec: Optional[float] = None
+        self.batches_per_sec: Optional[float] = None
+
+    def iteration_done(self, model, iteration, epoch):
+        now = time.perf_counter()
+        if self._last_time is not None and iteration % self.frequency == 0:
+            dt = max(now - self._last_time, 1e-9)
+            batch = getattr(model, "last_batch_size", None)
+            self.batches_per_sec = self.frequency / dt
+            if batch:
+                self.samples_per_sec = batch * self.frequency / dt
+            msg = (f"iteration {iteration}: {self.batches_per_sec:.1f} batches/sec"
+                   + (f", {self.samples_per_sec:.1f} samples/sec" if batch else ""))
+            if self.report_score:
+                msg += f", score {model.score()}"
+            log.info(msg)
+        if iteration % self.frequency == 0:
+            self._last_time = now
+
+
+class CollectScoresIterationListener(TrainingListener):
+    """Collect (iteration, score) pairs (reference CollectScoresIterationListener.java)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[Tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, float(model.score())))
+
+
+class TimeIterationListener(TrainingListener):
+    """ETA logging (reference TimeIterationListener.java)."""
+
+    def __init__(self, iteration_count: int):
+        self.iteration_count = iteration_count
+        self.start = time.perf_counter()
+
+    def iteration_done(self, model, iteration, epoch):
+        elapsed = time.perf_counter() - self.start
+        done = iteration + 1
+        remaining = (self.iteration_count - done) * elapsed / max(done, 1)
+        log.info("Remaining time: %d min %d sec", int(remaining // 60), int(remaining % 60))
+
+
+class SleepyTrainingListener(TrainingListener):
+    """Debug throttling (reference SleepyTrainingListener.java)."""
+
+    def __init__(self, sleep_ms: int = 0):
+        self.sleep_ms = sleep_ms
+
+    def iteration_done(self, model, iteration, epoch):
+        if self.sleep_ms:
+            time.sleep(self.sleep_ms / 1000.0)

@@ -1,0 +1,226 @@
+"""Updaters (optimizer configs) and learning-rate schedules.
+
+Parity surface: ND4J ``org.nd4j.linalg.learning.config.*`` (Sgd, Adam, AdaMax,
+AdaDelta, AdaGrad, Nadam, Nesterovs, RmsProp, NoOp) — the classes every layer
+config in the reference carries (``nn/conf/layers/Layer.java`` iupdater field) —
+and the updater-chain machinery in
+deeplearning4j-nn/.../nn/updater/BaseMultiLayerUpdater.java:38.
+
+TPU-native design: each updater is a frozen dataclass that lowers to an optax
+GradientTransformation; the whole optimizer step runs inside the jit-compiled
+train step (no per-block Java loop — UpdaterBlock.java:104 disappears into XLA).
+Per-layer updater overrides are supported by building one transformation per
+layer (mirroring UpdaterBlock's grouping by identical config).
+
+Gradient normalization (reference nn/conf/GradientNormalization.java) is
+implemented as optax-style per-layer transforms in ``gradient_normalization``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+_UPDATER_REGISTRY = {}
+
+
+def register_updater(cls):
+    _UPDATER_REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def _schedule(base_lr, policy, decay_rate, steps, power, schedule_map):
+    """Lower a DL4J learning-rate decay policy to an optax schedule.
+
+    Reference: LearningRatePolicy (ND4J) + MultiLayerConfiguration lr schedule
+    handling. Policies: none|exponential|inverse|poly|sigmoid|step|schedule.
+    """
+    p = (policy or "none").lower()
+    if p == "none":
+        return base_lr
+    if p == "exponential":
+        return lambda step: base_lr * jnp.power(decay_rate, step)
+    if p == "inverse":
+        return lambda step: base_lr / jnp.power(1.0 + decay_rate * step, power)
+    if p == "poly":
+        return lambda step: base_lr * jnp.power(1.0 - jnp.minimum(step / float(steps), 1.0), power)
+    if p == "sigmoid":
+        return lambda step: base_lr / (1.0 + jnp.exp(decay_rate * (step - steps)))
+    if p == "step":
+        return lambda step: base_lr * jnp.power(decay_rate, jnp.floor(step / float(steps)))
+    if p == "schedule":
+        if not schedule_map:
+            return base_lr
+        bounds = sorted(int(k) for k in schedule_map)
+        rates = [float(schedule_map[k] if k in schedule_map else schedule_map[str(k)]) for k in bounds]
+
+        def sched(step):
+            lr = jnp.asarray(base_lr, jnp.float32)
+            for b, r in zip(bounds, rates):
+                lr = jnp.where(step >= b, r, lr)
+            return lr
+
+        return sched
+    raise ValueError(f"Unknown lr policy '{policy}'")
+
+
+@dataclasses.dataclass(frozen=True)
+class Updater:
+    """Base updater config. ``learning_rate`` plus optional decay policy."""
+
+    learning_rate: float = 1e-3
+    lr_policy: Optional[str] = None
+    lr_decay_rate: float = 0.0
+    lr_policy_steps: float = 1.0
+    lr_policy_power: float = 2.0
+    lr_schedule: Optional[dict] = None
+
+    def _lr(self):
+        return _schedule(
+            self.learning_rate, self.lr_policy, self.lr_decay_rate,
+            self.lr_policy_steps, self.lr_policy_power, self.lr_schedule,
+        )
+
+    def to_optax(self) -> optax.GradientTransformation:
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = {k: v for k, v in dataclasses.asdict(self).items() if v is not None}
+        d["@class"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        cls = _UPDATER_REGISTRY[d.pop("@class").lower()]
+        return cls(**d)
+
+
+@register_updater
+@dataclasses.dataclass(frozen=True)
+class Sgd(Updater):
+    def to_optax(self):
+        return optax.sgd(self._lr())
+
+
+@register_updater
+@dataclasses.dataclass(frozen=True)
+class Nesterovs(Updater):
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+    momentum_schedule: Optional[dict] = None
+
+    def to_optax(self):
+        return optax.sgd(self._lr(), momentum=self.momentum, nesterov=True)
+
+
+@register_updater
+@dataclasses.dataclass(frozen=True)
+class Adam(Updater):
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.adam(self._lr(), b1=self.beta1, b2=self.beta2, eps=self.epsilon)
+
+
+@register_updater
+@dataclasses.dataclass(frozen=True)
+class AdaMax(Adam):
+    def to_optax(self):
+        return optax.adamax(self._lr(), b1=self.beta1, b2=self.beta2, eps=self.epsilon)
+
+
+@register_updater
+@dataclasses.dataclass(frozen=True)
+class Nadam(Adam):
+    def to_optax(self):
+        return optax.nadam(self._lr(), b1=self.beta1, b2=self.beta2, eps=self.epsilon)
+
+
+@register_updater
+@dataclasses.dataclass(frozen=True)
+class AdaGrad(Updater):
+    learning_rate: float = 0.1
+    epsilon: float = 1e-6
+
+    def to_optax(self):
+        return optax.adagrad(self._lr(), eps=self.epsilon)
+
+
+@register_updater
+@dataclasses.dataclass(frozen=True)
+class RmsProp(Updater):
+    learning_rate: float = 0.1
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.rmsprop(self._lr(), decay=self.rms_decay, eps=self.epsilon)
+
+
+@register_updater
+@dataclasses.dataclass(frozen=True)
+class AdaDelta(Updater):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def to_optax(self):
+        return optax.adadelta(rho=self.rho, eps=self.epsilon)
+
+
+@register_updater
+@dataclasses.dataclass(frozen=True)
+class NoOp(Updater):
+    """Frozen params (reference nn/conf/layers/misc/FrozenLayer uses NoOp)."""
+
+    def to_optax(self):
+        return optax.set_to_zero()
+
+
+def gradient_normalization(kind: Optional[str], threshold: float = 1.0):
+    """Per-layer gradient normalization (reference GradientNormalization enum,
+    applied in BaseMultiLayerUpdater.preApply).
+
+    Returns a function grads_dict -> grads_dict applied to one layer's grads.
+    """
+    if not kind or str(kind).lower() == "none":
+        return lambda g: g
+    k = str(kind).lower()
+
+    def l2(g):
+        leaves = jax.tree_util.tree_leaves(g)
+        return jnp.sqrt(sum(jnp.sum(x * x) for x in leaves) + 1e-12)
+
+    if k == "renormalizel2perlayer":
+        def f(g):
+            n = l2(g)
+            return jax.tree_util.tree_map(lambda x: x / n, g)
+        return f
+    if k == "renormalizel2perparamtype":
+        def f(g):
+            return jax.tree_util.tree_map(lambda x: x / jnp.sqrt(jnp.sum(x * x) + 1e-12), g)
+        return f
+    if k == "clipelementwiseabsolutevalue":
+        def f(g):
+            return jax.tree_util.tree_map(lambda x: jnp.clip(x, -threshold, threshold), g)
+        return f
+    if k == "clipl2perlayer":
+        def f(g):
+            n = l2(g)
+            scale = jnp.minimum(1.0, threshold / n)
+            return jax.tree_util.tree_map(lambda x: x * scale, g)
+        return f
+    if k == "clipl2perparamtype":
+        def f(g):
+            return jax.tree_util.tree_map(
+                lambda x: x * jnp.minimum(1.0, threshold / jnp.sqrt(jnp.sum(x * x) + 1e-12)), g)
+        return f
+    raise ValueError(f"Unknown gradient normalization '{kind}'")

@@ -1,0 +1,29 @@
+"""Test harness config.
+
+Tests run on a virtual 8-device CPU mesh (the sharding/parallelism suites need
+multiple devices; real multi-chip TPU hardware is not available in CI). The
+axon sitecustomize pre-registers the TPU backend, so the platform must be
+re-forced to cpu after the jax import — env vars alone are overridden.
+
+Mirrors the reference's approach of running distributed tests without a
+cluster (Spark local[N] — dl4j-spark/.../BaseSparkTest.java:89).
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    d = jax.devices()
+    assert len(d) == 8, f"expected 8 virtual CPU devices, got {d}"
+    return d

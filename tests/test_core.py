@@ -1,0 +1,149 @@
+"""Core engine tests: config building, JSON round-trip, init shapes, and
+end-to-end training on Iris (the reference's canonical small fixture —
+deeplearning4j-core/src/test uses IrisDataSetIterator throughout, e.g.
+nn/multilayer/MultiLayerTest.java)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, MultiLayerConfiguration, InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer, ActivationLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.updaters import Adam, Sgd, Nesterovs
+from deeplearning4j_tpu.datasets import IrisDataSetIterator, ListDataSetIterator, AsyncDataSetIterator
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+def iris_mlp_conf(seed=42, updater=None):
+    return (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(updater or Adam(learning_rate=0.02))
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+
+
+def test_builder_wires_n_in():
+    conf = iris_mlp_conf()
+    layers = conf.wired_layers()
+    assert layers[0].n_in == 4
+    assert layers[1].n_in == 16
+    assert layers[2].n_in == 16
+
+
+def test_global_defaults_applied():
+    conf = iris_mlp_conf()
+    assert conf.layers[0].weight_init == "xavier"
+    assert isinstance(conf.layers[0].updater, Adam)
+
+
+def test_json_round_trip():
+    conf = iris_mlp_conf()
+    s = conf.to_json()
+    back = MultiLayerConfiguration.from_json(s)
+    assert back == conf
+
+
+def test_init_shapes_and_param_count():
+    net = MultiLayerNetwork(iris_mlp_conf()).init()
+    assert net.params[0]["W"].shape == (4, 16)
+    assert net.params[0]["b"].shape == (16,)
+    assert net.params[2]["W"].shape == (16, 3)
+    expected = 4 * 16 + 16 + 16 * 16 + 16 + 16 * 3 + 3
+    assert net.num_params() == expected
+
+
+def test_output_shape_and_softmax():
+    net = MultiLayerNetwork(iris_mlp_conf()).init()
+    x = np.random.default_rng(0).random((7, 4), np.float32)
+    out = net.output(x)
+    assert out.shape == (7, 3)
+    np.testing.assert_allclose(out.sum(axis=-1), np.ones(7), rtol=1e-5)
+
+
+def test_fit_decreases_score():
+    net = MultiLayerNetwork(iris_mlp_conf()).init()
+    it = IrisDataSetIterator(batch=150)
+    ds = next(iter(it))
+    s0 = net.score_dataset(ds)
+    net.fit(it, num_epochs=30)
+    s1 = net.score_dataset(ds)
+    assert s1 < s0 * 0.7, (s0, s1)
+
+
+def test_iris_end_to_end_accuracy():
+    """LeNet-equivalent of the reference's Iris smoke tests: full training to
+    >90% train accuracy."""
+    net = MultiLayerNetwork(iris_mlp_conf()).init()
+    it = IrisDataSetIterator(batch=50)
+    net.fit(it, num_epochs=120)
+    ds = next(iter(IrisDataSetIterator(batch=150)))
+    preds = net.predict(ds.features)
+    acc = (preds == np.argmax(ds.labels, -1)).mean()
+    assert acc > 0.9, acc
+
+
+def test_score_reproducible_with_seed():
+    a = MultiLayerNetwork(iris_mlp_conf(seed=7)).init()
+    b = MultiLayerNetwork(iris_mlp_conf(seed=7)).init()
+    x = np.random.default_rng(1).random((5, 4), np.float32)
+    np.testing.assert_allclose(a.output(x), b.output(x), rtol=1e-6)
+
+
+def test_sgd_and_nesterovs_train():
+    for upd in (Sgd(learning_rate=0.5), Nesterovs(learning_rate=0.1, momentum=0.9)):
+        net = MultiLayerNetwork(iris_mlp_conf(updater=upd)).init()
+        it = IrisDataSetIterator(batch=150)
+        ds = next(iter(it))
+        s0 = net.score_dataset(ds)
+        net.fit(it, num_epochs=40)
+        assert net.score_dataset(ds) < s0
+
+
+def test_l2_regularization_increases_score_term():
+    base = iris_mlp_conf()
+    reg = (NeuralNetConfiguration.builder()
+           .seed(42).updater(Adam(0.02)).weight_init("xavier").l2(0.1)
+           .list()
+           .layer(DenseLayer(n_out=16, activation="relu"))
+           .layer(DenseLayer(n_out=16, activation="tanh"))
+           .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+           .set_input_type(InputType.feed_forward(4))
+           .build())
+    ds = next(iter(IrisDataSetIterator(batch=150)))
+    n1 = MultiLayerNetwork(base).init()
+    n2 = MultiLayerNetwork(reg).init()
+    assert n2.score_dataset(ds) > n1.score_dataset(ds)
+
+
+def test_async_iterator_matches_sync():
+    it = IrisDataSetIterator(batch=50)
+    sync = [ds.features.sum() for ds in it]
+    async_it = AsyncDataSetIterator(IrisDataSetIterator(batch=50))
+    asyn = [ds.features.sum() for ds in async_it]
+    np.testing.assert_allclose(sorted(sync), sorted(asyn))
+
+
+def test_iterator_reset_reusable():
+    it = IrisDataSetIterator(batch=50)
+    assert len(list(it)) == 3
+    assert len(list(it)) == 3  # __iter__ resets
+
+
+def test_dropout_only_active_in_training():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_out=32, activation="relu", dropout=0.5))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).random((4, 4), np.float32)
+    o1 = net.output(x)
+    o2 = net.output(x)
+    np.testing.assert_allclose(o1, o2)  # inference is deterministic
