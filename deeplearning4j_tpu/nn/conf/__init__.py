@@ -3,3 +3,8 @@ from deeplearning4j_tpu.nn.conf.network import (  # noqa: F401
     NeuralNetConfiguration,
     MultiLayerConfiguration,
 )
+
+# import layer modules for their registry side effects (JSON serde)
+from deeplearning4j_tpu.nn.conf import convolutional as _conv  # noqa: F401,E402
+from deeplearning4j_tpu.nn.conf import normalization as _norm  # noqa: F401,E402
+from deeplearning4j_tpu.nn.conf import pooling as _pool  # noqa: F401,E402

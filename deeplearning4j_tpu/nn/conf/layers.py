@@ -72,6 +72,8 @@ def layer_from_dict(d: dict):
             v = Updater.from_dict(v)
         elif k == "dist" and isinstance(v, dict):
             v = Distribution.from_dict(v)
+        elif isinstance(v, list):  # JSON has no tuples
+            v = tuple(v)
         kwargs[k] = v
     return cls(**kwargs)
 

@@ -1,0 +1,94 @@
+"""Normalization layers: BatchNormalization, LocalResponseNormalization.
+
+Parity surface: reference ``nn/conf/layers/BatchNormalization.java`` +
+``nn/layers/normalization/BatchNormalization.java:57`` (helper hook; cuDNN
+path CudnnBatchNormalizationHelper.java) and
+``LocalResponseNormalization.java`` (+ CudnnLocalResponseNormalizationHelper).
+
+TPU-native: one fused traced expression; the running-stat buffers live in the
+layer *state* pytree (non-trainable), updated functionally inside the jitted
+train step — no mutable INDArray views.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import BaseLayer, Layer, register_layer
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class BatchNormalization(BaseLayer):
+    """Batch norm over the channel/feature axis (last axis in both the
+    (batch, features) and NHWC layouts). Reference defaults: decay=0.9,
+    eps=1e-5, lockGammaBeta=false (BatchNormalization.java conf)."""
+
+    decay: float = 0.9
+    eps: float = 1e-5
+    lock_gamma_beta: bool = False
+    gamma: float = 1.0  # fixed value when locked
+    beta: float = 0.0
+
+    def regularizable(self):
+        return ()
+
+    def init(self, rng, it: InputType, dtype=jnp.float32):
+        n = it.channels if it.kind == "cnn" else it.flat_size()
+        params = {}
+        if not self.lock_gamma_beta:
+            params = {"gamma": jnp.full((n,), self.gamma, dtype),
+                      "beta": jnp.full((n,), self.beta, dtype)}
+        state = {"mean": jnp.zeros((n,), dtype), "var": jnp.ones((n,), dtype)}
+        return params, state
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        axes = tuple(range(x.ndim - 1))  # all but channel/feature axis
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1.0 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1.0 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        xhat = (x - mean) * lax.rsqrt(var + self.eps)
+        if self.lock_gamma_beta:
+            out = self.gamma * xhat + self.beta
+        else:
+            out = params["gamma"] * xhat + params["beta"]
+        return out, new_state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class LocalResponseNormalization(Layer):
+    """Cross-channel LRN (reference nn/conf/layers/LocalResponseNormalization.java;
+    defaults k=2, n=5, alpha=1e-4, beta=0.75 as in the reference conf)."""
+
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def input_kind(self):
+        return "cnn"
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        # sum of squares over a window of n channels centred on each channel;
+        # asymmetric pad (half, n-1-half) keeps the channel count for even n
+        half = self.n // 2
+        sq = x * x
+        summed = lax.reduce_window(
+            sq, 0.0, lax.add,
+            window_dimensions=(1, 1, 1, self.n),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (0, 0), (0, 0), (half, self.n - 1 - half)),
+        )
+        return x / jnp.power(self.k + self.alpha * summed, self.beta), state

@@ -1,0 +1,65 @@
+"""Global pooling.
+
+Parity surface: reference ``nn/conf/layers/GlobalPoolingLayer.java`` +
+``nn/layers/pooling/GlobalPoolingLayer.java``: pools over spatial dims (CNN
+NHWC -> feed-forward) or over time (RNN (batch, time, size) -> feed-forward),
+mask-aware for variable-length sequences.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import Layer, register_layer
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class GlobalPoolingLayer(Layer):
+    """pooling_type: max | avg | sum | pnorm (reference PoolingType enum)."""
+
+    pooling_type: str = "max"
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+
+    def output_type(self, it: InputType) -> InputType:
+        if it.kind == "cnn":
+            return InputType.feed_forward(it.channels)
+        if it.kind == "rnn":
+            return InputType.feed_forward(it.size)
+        return it
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if x.ndim == 4:      # NHWC: pool over H, W
+            axes = (1, 2)
+            m = None
+        elif x.ndim == 3:    # (batch, time, size): pool over time, mask-aware
+            axes = (1,)
+            m = None if mask is None else mask[..., None]  # (b, t, 1)
+        else:
+            return x, state
+        pt = self.pooling_type.lower()
+        if pt == "max":
+            if m is not None:
+                x = jnp.where(m > 0, x, -jnp.inf)
+            out = jnp.max(x, axis=axes)
+        elif pt == "sum":
+            if m is not None:
+                x = x * m
+            out = jnp.sum(x, axis=axes)
+        elif pt == "avg":
+            if m is not None:
+                out = jnp.sum(x * m, axis=axes) / jnp.maximum(jnp.sum(m, axis=axes), 1.0)
+            else:
+                out = jnp.mean(x, axis=axes)
+        elif pt == "pnorm":
+            p = float(self.pnorm)
+            if m is not None:
+                x = x * m
+            out = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axes), 1.0 / p)
+        else:
+            raise ValueError(f"Unknown pooling type '{self.pooling_type}'")
+        return out, state

@@ -45,10 +45,12 @@ class MultiLayerNetwork:
         if not self.layers:
             raise ValueError("Empty layer list")
         self._dtype = _compute_dtype(conf.dtype)
-        # per-layer optax transforms (reference BaseMultiLayerUpdater blocks)
+        # per-layer optax transforms (reference BaseMultiLayerUpdater blocks).
+        # Every layer gets its updater — a layer whose init() returns an empty
+        # param dict makes the transform a no-op, and layers with
+        # non-regularizable trainables (e.g. batchnorm gamma/beta) still train.
         self._txs = [
             (l.updater if getattr(l, "updater", None) is not None else conf.updater).to_optax()
-            if (l.regularizable() or self._layer_has_params(l)) else optax.set_to_zero()
             for l in self.layers
         ]
         self._gnorms = [
@@ -68,10 +70,6 @@ class MultiLayerNetwork:
         self._jit_cache = {}
 
     # ------------------------------------------------------------------ init
-    @staticmethod
-    def _layer_has_params(layer) -> bool:
-        return bool(layer.regularizable())
-
     def init(self, seed: Optional[int] = None) -> "MultiLayerNetwork":
         """Initialize params/optimizer state (reference MultiLayerNetwork.init :541)."""
         rng = jax.random.key(self.conf.seed if seed is None else seed)
@@ -126,7 +124,9 @@ class MultiLayerNetwork:
                 rng, k = jax.random.split(rng)
             if i == n - 1 and layer.is_output_layer():
                 x_in = dropout_input(x, layer.dropout, train, k)
-                preout = layer.pre_output(params[i], x_in).astype(jnp.float32)
+                preout = layer.pre_output(params[i], x_in)
+                if preout.dtype in (jnp.bfloat16, jnp.float16):
+                    preout = preout.astype(jnp.float32)  # loss math in f32
                 x = get_activation(layer.activation)(preout)
                 new_state.append(state[i])
             else:
@@ -146,13 +146,17 @@ class MultiLayerNetwork:
             l2b = getattr(layer, "l2_bias", 0.0) or 0.0
             for key in layer.regularizable():
                 if key in p:
-                    w = p[key].astype(jnp.float32)
+                    w = p[key]
+                    if w.dtype in (jnp.bfloat16, jnp.float16):
+                        w = w.astype(jnp.float32)
                     if l2:
                         total = total + 0.5 * l2 * jnp.sum(w * w)
                     if l1:
                         total = total + l1 * jnp.sum(jnp.abs(w))
             if (l1b or l2b) and "b" in p:
-                b = p["b"].astype(jnp.float32)
+                b = p["b"]
+                if b.dtype in (jnp.bfloat16, jnp.float16):
+                    b = b.astype(jnp.float32)
                 if l2b:
                     total = total + 0.5 * l2b * jnp.sum(b * b)
                 if l1b:
@@ -166,7 +170,9 @@ class MultiLayerNetwork:
             raise ValueError("Last layer must be an output/loss layer to fit()")
         acts, preout, new_state, cur_mask = self._forward(params, state, x, True, rng, fmask)
         lm = lmask if lmask is not None else (cur_mask if cur_mask is not None else None)
-        loss = out_layer.compute_score(y.astype(jnp.float32), preout, lm)
+        if y.dtype in (jnp.bfloat16, jnp.float16):
+            y = y.astype(jnp.float32)
+        loss = out_layer.compute_score(y, preout, lm)
         loss = loss + self._regularization(params)
         return loss, new_state
 
@@ -199,7 +205,9 @@ class MultiLayerNetwork:
                 def score_fn(params, state, x, y, fmask, lmask):
                     _, preout, _, cur_mask = self._forward(params, state, x, False, None, fmask)
                     lm = lmask if lmask is not None else cur_mask
-                    return (self.layers[-1].compute_score(y.astype(jnp.float32), preout, lm)
+                    if y.dtype in (jnp.bfloat16, jnp.float16):
+                        y = y.astype(jnp.float32)
+                    return (self.layers[-1].compute_score(y, preout, lm)
                             + self._regularization(params))
                 fn = jax.jit(score_fn)
             else:
