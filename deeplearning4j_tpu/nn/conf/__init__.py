@@ -8,3 +8,4 @@ from deeplearning4j_tpu.nn.conf.network import (  # noqa: F401
 from deeplearning4j_tpu.nn.conf import convolutional as _conv  # noqa: F401,E402
 from deeplearning4j_tpu.nn.conf import normalization as _norm  # noqa: F401,E402
 from deeplearning4j_tpu.nn.conf import pooling as _pool  # noqa: F401,E402
+from deeplearning4j_tpu.nn.conf import recurrent as _rnn  # noqa: F401,E402

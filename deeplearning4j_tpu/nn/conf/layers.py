@@ -72,6 +72,8 @@ def layer_from_dict(d: dict):
             v = Updater.from_dict(v)
         elif k == "dist" and isinstance(v, dict):
             v = Distribution.from_dict(v)
+        elif isinstance(v, dict) and "@class" in v:  # nested layer (e.g. Bidirectional)
+            v = layer_from_dict(v)
         elif isinstance(v, list):  # JSON has no tuples
             v = tuple(v)
         kwargs[k] = v
@@ -109,10 +111,6 @@ class Layer:
     # which param keys get l1/l2 (weights only, like DL4J's regularization-by-param-type)
     def regularizable(self) -> Tuple[str, ...]:
         return ()
-
-    # keys whose params should NOT be updated when layer is frozen etc.
-    def has_params(self) -> bool:
-        return bool(self.regularizable()) or False
 
     def is_output_layer(self) -> bool:
         return False

@@ -81,14 +81,16 @@ class LocalResponseNormalization(Layer):
         return "cnn"
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
-        # sum of squares over a window of n channels centred on each channel;
-        # asymmetric pad (half, n-1-half) keeps the channel count for even n
+        # sum of squares over a centred window of 2*(n//2)+1 channels — the
+        # reference loops i=1..n/2 on both sides of the centre
+        # (LocalResponseNormalization.java halfN), so even n covers n+1 channels
         half = self.n // 2
+        win = 2 * half + 1
         sq = x * x
         summed = lax.reduce_window(
             sq, 0.0, lax.add,
-            window_dimensions=(1, 1, 1, self.n),
+            window_dimensions=(1, 1, 1, win),
             window_strides=(1, 1, 1, 1),
-            padding=((0, 0), (0, 0), (0, 0), (half, self.n - 1 - half)),
+            padding=((0, 0), (0, 0), (0, 0), (half, half)),
         )
         return x / jnp.power(self.k + self.alpha * summed, self.beta), state

@@ -23,13 +23,17 @@ class GlobalPoolingLayer(Layer):
 
     pooling_type: str = "max"
     pnorm: int = 2
-    collapse_dimensions: bool = True
+    collapse_dimensions: bool = True  # False keeps size-1 pooled dims
 
     def output_type(self, it: InputType) -> InputType:
         if it.kind == "cnn":
-            return InputType.feed_forward(it.channels)
+            if self.collapse_dimensions:
+                return InputType.feed_forward(it.channels)
+            return InputType.convolutional(1, 1, it.channels)
         if it.kind == "rnn":
-            return InputType.feed_forward(it.size)
+            if self.collapse_dimensions:
+                return InputType.feed_forward(it.size)
+            return InputType.recurrent(it.size, 1)
         return it
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
@@ -41,25 +45,28 @@ class GlobalPoolingLayer(Layer):
             m = None if mask is None else mask[..., None]  # (b, t, 1)
         else:
             return x, state
+        keep = not self.collapse_dimensions
         pt = self.pooling_type.lower()
         if pt == "max":
             if m is not None:
                 x = jnp.where(m > 0, x, -jnp.inf)
-            out = jnp.max(x, axis=axes)
+            out = jnp.max(x, axis=axes, keepdims=keep)
         elif pt == "sum":
             if m is not None:
                 x = x * m
-            out = jnp.sum(x, axis=axes)
+            out = jnp.sum(x, axis=axes, keepdims=keep)
         elif pt == "avg":
             if m is not None:
-                out = jnp.sum(x * m, axis=axes) / jnp.maximum(jnp.sum(m, axis=axes), 1.0)
+                out = (jnp.sum(x * m, axis=axes, keepdims=keep)
+                       / jnp.maximum(jnp.sum(m, axis=axes, keepdims=keep), 1.0))
             else:
-                out = jnp.mean(x, axis=axes)
+                out = jnp.mean(x, axis=axes, keepdims=keep)
         elif pt == "pnorm":
             p = float(self.pnorm)
             if m is not None:
                 x = x * m
-            out = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axes), 1.0 / p)
+            out = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axes, keepdims=keep),
+                            1.0 / p)
         else:
             raise ValueError(f"Unknown pooling type '{self.pooling_type}'")
         return out, state

@@ -68,6 +68,7 @@ class MultiLayerNetwork:
         self._score: Optional[float] = None
         self._rng = None
         self._jit_cache = {}
+        self._rnn_carries = None  # stateful rnnTimeStep carries
 
     # ------------------------------------------------------------------ init
     def init(self, seed: Optional[int] = None) -> "MultiLayerNetwork":
@@ -103,12 +104,18 @@ class MultiLayerNetwork:
         return None if self._score is None else float(self._score)
 
     # --------------------------------------------------------------- forward
-    def _forward(self, params, state, x, train: bool, rng, fmask):
+    def _forward(self, params, state, x, train: bool, rng, fmask, carries=None):
         """Full forward pass; returns (activations list, preout of output
-        layer, new_state, final mask). Traced by jit — the reference's
-        feedForwardToLayer loop unrolls into one XLA graph."""
+        layer, new_state, final mask, new_carries). Traced by jit — the
+        reference's feedForwardToLayer loop unrolls into one XLA graph.
+
+        ``carries`` (list of per-layer RNN state pytrees, {} for
+        non-recurrent layers) enables stateful recurrence: truncated BPTT
+        (reference doTruncatedBPTT — MultiLayerNetwork.java:1393) and
+        rnnTimeStep (:2615)."""
         acts = []
         new_state = []
+        new_carries = []
         preout = None
         cur_mask = fmask
         cdt = self._dtype
@@ -129,11 +136,20 @@ class MultiLayerNetwork:
                     preout = preout.astype(jnp.float32)  # loss math in f32
                 x = get_activation(layer.activation)(preout)
                 new_state.append(state[i])
+                new_carries.append({})
+            elif (carries is not None and hasattr(layer, "apply_seq")
+                  and getattr(layer, "supports_stateful", True)):
+                x_in = dropout_input(x, layer.dropout, train, k)
+                x, nc = layer.apply_seq(params[i], carries[i], x_in,
+                                        train=train, rng=None, mask=cur_mask)
+                new_state.append(state[i])
+                new_carries.append(nc)
             else:
                 x, st = layer.apply(params[i], state[i], x, train=train, rng=k, mask=cur_mask)
                 new_state.append(st)
+                new_carries.append({})
             acts.append(x)
-        return acts, preout, new_state, cur_mask
+        return acts, preout, new_state, cur_mask, new_carries
 
     def _regularization(self, params):
         """L1/L2 penalty (reference BaseLayer.calcL2/calcL1; score term added in
@@ -168,7 +184,7 @@ class MultiLayerNetwork:
         out_layer = self.layers[-1]
         if not out_layer.is_output_layer():
             raise ValueError("Last layer must be an output/loss layer to fit()")
-        acts, preout, new_state, cur_mask = self._forward(params, state, x, True, rng, fmask)
+        acts, preout, new_state, cur_mask, _ = self._forward(params, state, x, True, rng, fmask)
         lm = lmask if lmask is not None else (cur_mask if cur_mask is not None else None)
         if y.dtype in (jnp.bfloat16, jnp.float16):
             y = y.astype(jnp.float32)
@@ -192,18 +208,103 @@ class MultiLayerNetwork:
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
+    # ------------------------------------------------- truncated BPTT / state
+    def _zero_carries(self, batch: int):
+        return [l.init_carry(batch) if hasattr(l, "init_carry") else {}
+                for l in self.layers]
+
+    def _loss_fn_tbptt(self, params, state, carries, x, y, rng, fmask, lmask):
+        out_layer = self.layers[-1]
+        acts, preout, new_state, cur_mask, new_carries = self._forward(
+            params, state, x, True, rng, fmask, carries)
+        lm = lmask if lmask is not None else cur_mask
+        if y.dtype in (jnp.bfloat16, jnp.float16):
+            y = y.astype(jnp.float32)
+        loss = out_layer.compute_score(y, preout, lm) + self._regularization(params)
+        return loss, (new_state, new_carries)
+
+    def _make_tbptt_step(self):
+        """One tBPTT window update (reference doTruncatedBPTT —
+        MultiLayerNetwork.java:1393). Incoming carries are constants of the
+        traced program, so gradients truncate at the window boundary exactly
+        like the reference's stored-state scheme."""
+        value_and_grad = jax.value_and_grad(self._loss_fn_tbptt, has_aux=True)
+
+        def step(params, state, opt_state, carries, rng, x, y, fmask, lmask):
+            (loss, (new_state, new_carries)), grads = value_and_grad(
+                params, state, carries, x, y, rng, fmask, lmask)
+            new_params = []
+            new_opt = []
+            for i, tx in enumerate(self._txs):
+                g = self._gnorms[i](grads[i])
+                updates, os = tx.update(g, opt_state[i], params[i])
+                new_params.append(optax.apply_updates(params[i], updates))
+                new_opt.append(os)
+            return new_params, new_state, new_opt, new_carries, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+    def rnn_time_step(self, x) -> np.ndarray:
+        """Stateful step-by-step inference (reference
+        MultiLayerNetwork.rnnTimeStep :2615): carries (h, c) across calls."""
+        for layer in self.layers:
+            if not getattr(layer, "supports_stateful", True):
+                raise NotImplementedError(
+                    f"rnn_time_step is not supported with {type(layer).__name__}: "
+                    "the backward direction needs the full sequence (reference "
+                    "GravesBidirectionalLSTM.rnnTimeStep throws the same)")
+        x = jnp.asarray(x)
+        squeeze = False
+        if getattr(self.layers[0], "takes_index_sequence", False):
+            if x.ndim == 1:  # single timestep of ids (batch,)
+                x = x[:, None]
+                squeeze = True
+            elif x.ndim == 2 and x.shape[1] == 1:
+                squeeze = True
+            # else: (batch, time) id sequence — already has a time axis
+        elif x.ndim == 2:  # single timestep (batch, features)
+            x = x[:, None, :]
+            squeeze = True
+        b = x.shape[0]
+        if self._rnn_carries is None:
+            self._rnn_carries = self._zero_carries(b)
+        else:
+            leaves = jax.tree_util.tree_leaves(self._rnn_carries)
+            if leaves and leaves[0].shape[0] != b:
+                raise ValueError(
+                    f"rnn_time_step batch size {b} does not match stored state "
+                    f"batch {leaves[0].shape[0]}; call rnn_clear_previous_state() first")
+        fn = self._get_jitted("rnn_step")
+        out, self._rnn_carries = fn(self.params, self.state, self._rnn_carries, x)
+        out = np.asarray(out)
+        return out[:, -1, :] if (squeeze and out.ndim == 3) else out
+
+    def rnn_clear_previous_state(self):
+        """reference MultiLayerNetwork.rnnClearPreviousState."""
+        self._rnn_carries = None
+
+    def rnn_get_previous_state(self):
+        return self._rnn_carries
+
     def _get_jitted(self, kind, key=()):
         k = (kind,) + tuple(key)
         fn = self._jit_cache.get(k)
         if fn is None:
             if kind == "train":
                 fn = self._make_train_step()
+            elif kind == "tbptt":
+                fn = self._make_tbptt_step()
+            elif kind == "rnn_step":
+                fn = jax.jit(lambda params, state, carries, x:
+                             (lambda r: (r[0][-1], r[4]))(
+                                 self._forward(params, state, x, False, None,
+                                               None, carries)))
             elif kind == "output":
                 fn = jax.jit(lambda params, state, x, fmask:
                              self._forward(params, state, x, False, None, fmask)[0][-1])
             elif kind == "score":
                 def score_fn(params, state, x, y, fmask, lmask):
-                    _, preout, _, cur_mask = self._forward(params, state, x, False, None, fmask)
+                    _, preout, _, cur_mask, _ = self._forward(params, state, x, False, None, fmask)
                     lm = lmask if lmask is not None else cur_mask
                     if y.dtype in (jnp.bfloat16, jnp.float16):
                         y = y.astype(jnp.float32)
@@ -238,11 +339,22 @@ class MultiLayerNetwork:
         return self
 
     def _fit_batch(self, train_step, ds: DataSet):
-        self._rng, k = jax.random.split(self._rng)
         x = jnp.asarray(ds.features)
         y = jnp.asarray(ds.labels)
         fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
         lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        # tbptt applies when the input has a time axis: 3-D dense sequences or
+        # 2-D integer index sequences (EmbeddingSequenceLayer) under an RNN
+        # input type
+        has_time_axis = x.ndim == 3 or (
+            x.ndim == 2 and self.conf.input_type is not None
+            and self.conf.input_type.kind == "rnn"
+            and not self.layers[0].input_kind() == "ff")
+        if (self.conf.backprop_type == "tbptt" and has_time_axis
+                and x.shape[1] > self.conf.tbptt_fwd_length):
+            self._fit_tbptt(x, y, fm, lm)
+            return
+        self._rng, k = jax.random.split(self._rng)
         self.params, self.state, self.opt_state, loss = train_step(
             self.params, self.state, self.opt_state, k, x, y, fm, lm)
         self._score = loss
@@ -250,6 +362,32 @@ class MultiLayerNetwork:
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration, self.epoch)
         self.iteration += 1
+
+    def _fit_tbptt(self, x, y, fm, lm):
+        """Chunked fit over time windows (reference doTruncatedBPTT
+        MultiLayerNetwork.java:1393): one optimizer update per forward-length
+        window, with RNN state carried (but not differentiated) across
+        windows."""
+        step = self._get_jitted("tbptt")
+        T = x.shape[1]
+        L = self.conf.tbptt_fwd_length
+        carries = self._zero_carries(int(x.shape[0]))
+        for s in range(0, T, L):
+            e = min(s + L, T)
+            # keep window length static where possible: last ragged window
+            # gets its own jit specialization
+            xs = x[:, s:e]
+            ys = y[:, s:e] if y.ndim == 3 else y
+            fs = None if fm is None else fm[:, s:e]
+            ls = None if lm is None else lm[:, s:e]
+            self._rng, k = jax.random.split(self._rng)
+            self.params, self.state, self.opt_state, carries, loss = step(
+                self.params, self.state, self.opt_state, carries, k, xs, ys, fs, ls)
+            self._score = loss
+            self.last_batch_size = int(x.shape[0])
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration, self.epoch)
+            self.iteration += 1
 
     # ---------------------------------------------------------------- output
     def output(self, x, train: bool = False) -> np.ndarray:
@@ -265,8 +403,8 @@ class MultiLayerNetwork:
 
     def feed_forward(self, x, train: bool = False):
         """All layer activations (reference feedForward :852)."""
-        acts, _, _, _ = self._forward(self.params, self.state, jnp.asarray(x),
-                                      train, None, None)
+        acts = self._forward(self.params, self.state, jnp.asarray(x),
+                             train, None, None)[0]
         return [np.asarray(a) for a in acts]
 
     def score_dataset(self, ds: DataSet) -> float:

@@ -259,3 +259,27 @@ def test_lrn_even_window():
     x = np.random.default_rng(0).random((2, 4, 4, 8)).astype(np.float32)
     out, _ = layer.apply({}, {}, x)
     assert out.shape == x.shape
+
+
+def test_lrn_matches_reference_window_semantics():
+    """LRN sums 2*(n//2)+1 channels (reference halfN loop), so n=2 covers 3."""
+    import jax.numpy as jnp
+    x = np.zeros((1, 1, 1, 5), np.float32)
+    x[0, 0, 0, 2] = 2.0  # single hot channel
+    layer = LocalResponseNormalization(n=2, k=1.0, alpha=1.0, beta=1.0)
+    out, _ = layer.apply({}, {}, jnp.asarray(x))
+    out = np.asarray(out)
+    # channels 1..3 see the squared 4.0 in their window: denom 1+4=5
+    np.testing.assert_allclose(out[0, 0, 0], [0, 0, 2/5, 0, 0], rtol=1e-6)
+
+
+def test_global_pooling_keep_dimensions():
+    conf = (NeuralNetConfiguration.builder().list()
+            .layer(GlobalPoolingLayer(pooling_type="avg", collapse_dimensions=False))
+            .layer(OutputLayer(n_out=2, loss="mcxent"))
+            .set_input_type(InputType.convolutional(4, 4, 3))
+            .build())
+    t = conf.layers[0].output_type(InputType.convolutional(4, 4, 3))
+    assert (t.kind, t.height, t.width, t.channels) == ("cnn", 1, 1, 3)
+    net = MultiLayerNetwork(conf).init()
+    assert net.output(np.ones((2, 4, 4, 3), np.float32)).shape == (2, 2)

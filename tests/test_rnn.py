@@ -1,0 +1,247 @@
+"""RNN stack tests: LSTM variants, masking, tBPTT, rnnTimeStep.
+
+Mirrors the reference suites LSTMGradientCheckTests.java,
+GradientCheckTestsMasking.java, and the rnnTimeStep tests in
+deeplearning4j-core/src/test/.../nn/multilayer/ (e.g.
+MultiLayerTestRNN.java)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, MultiLayerConfiguration, InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.recurrent import (
+    LSTM, GravesLSTM, Bidirectional, GravesBidirectionalLSTM, RnnOutputLayer,
+    EmbeddingLayer, EmbeddingSequenceLayer, LastTimeStep,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.updaters import Adam, NoOp
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.utils.gradient_check import check_gradients
+
+
+def _rnn_net(layers, input_type, seed=42, **kw):
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).updater(kw.pop("updater", NoOp())).weight_init("xavier").list())
+    for l in layers:
+        b = b.layer(l)
+    for k, v in kw.items():
+        getattr(b, k)(*v) if isinstance(v, tuple) else None
+    return MultiLayerNetwork(b.set_input_type(input_type).build()).init()
+
+
+def test_lstm_forward_shape():
+    net = _rnn_net([LSTM(n_out=7, activation="tanh"),
+                    RnnOutputLayer(n_out=3, loss="mcxent")],
+                   InputType.recurrent(5))
+    x = np.random.default_rng(0).standard_normal((2, 6, 5)).astype(np.float32)
+    out = net.output(x)
+    assert out.shape == (2, 6, 3)
+    np.testing.assert_allclose(out.sum(-1), np.ones((2, 6)), rtol=1e-4)
+
+
+def test_gradcheck_lstm():
+    """Reference: LSTMGradientCheckTests.java (no-peephole LSTM)."""
+    net = _rnn_net([LSTM(n_out=4, activation="tanh"),
+                    RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent")],
+                   InputType.recurrent(3))
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((2, 4, 3)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (2, 4))]
+    assert check_gradients(net, DataSet(x, y))
+
+
+def test_gradcheck_graves_lstm_peepholes():
+    """Reference: LSTMGradientCheckTests with GravesLSTM (peepholes)."""
+    net = _rnn_net([GravesLSTM(n_out=4, activation="tanh"),
+                    RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent")],
+                   InputType.recurrent(3))
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((2, 4, 3)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (2, 4))]
+    assert check_gradients(net, DataSet(x, y))
+
+
+def test_gradcheck_bidirectional_with_mask():
+    """Reference: GradientCheckTestsMasking.java — bidirectional + per-step mask."""
+    net = _rnn_net([GravesBidirectionalLSTM(layer=GravesLSTM(n_out=4, activation="tanh")),
+                    RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+                   InputType.recurrent(3))
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((2, 5, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (2, 5))]
+    fm = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.float32)
+    assert check_gradients(net, DataSet(x, y, features_mask=fm))
+
+
+def test_masked_steps_do_not_change_output():
+    """Padding beyond the mask must not affect outputs at valid steps
+    (reference masking semantics: feedForwardMaskArray)."""
+    net = _rnn_net([LSTM(n_out=6, activation="tanh"),
+                    RnnOutputLayer(n_out=2, loss="mcxent")],
+                   InputType.recurrent(4))
+    rng = np.random.default_rng(4)
+    x_short = rng.standard_normal((1, 3, 4)).astype(np.float32)
+    pad = rng.standard_normal((1, 2, 4)).astype(np.float32) * 100
+    x_padded = np.concatenate([x_short, pad], axis=1)
+    mask = np.array([[1, 1, 1, 0, 0]], np.float32)
+
+    import jax.numpy as jnp
+    acts_p, _, _, _, _ = net._forward(net.params, net.state, jnp.asarray(x_padded),
+                                      False, None, jnp.asarray(mask))
+    acts_s, _, _, _, _ = net._forward(net.params, net.state, jnp.asarray(x_short),
+                                      False, None, None)
+    np.testing.assert_allclose(np.asarray(acts_p[-1])[:, :3], np.asarray(acts_s[-1]),
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_rnn_time_step_matches_full_forward():
+    """Step-by-step stateful inference == one-shot full-sequence forward
+    (reference rnnTimeStep tests in MultiLayerTestRNN.java)."""
+    net = _rnn_net([GravesLSTM(n_out=5, activation="tanh"),
+                    RnnOutputLayer(n_out=2, loss="mcxent")],
+                   InputType.recurrent(3))
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((2, 6, 3)).astype(np.float32)
+    full = net.output(x)
+    net.rnn_clear_previous_state()
+    step_outs = [net.rnn_time_step(x[:, t, :]) for t in range(6)]
+    np.testing.assert_allclose(np.stack(step_outs, axis=1), full, rtol=2e-4, atol=1e-5)
+    # chunked: 2 steps then 4
+    net.rnn_clear_previous_state()
+    o1 = net.rnn_time_step(x[:, :2, :])
+    o2 = net.rnn_time_step(x[:, 2:, :])
+    np.testing.assert_allclose(np.concatenate([o1, o2], axis=1), full, rtol=2e-4, atol=1e-5)
+
+
+def test_tbptt_training_runs_and_learns():
+    """Truncated BPTT config (reference backpropType(TruncatedBPTT) +
+    tBPTTForwardLength — MultiLayerConfiguration.java:354-445)."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(6).updater(Adam(5e-3)).weight_init("xavier")
+            .list()
+            .layer(LSTM(n_out=12, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(4))
+            .backprop_type("tbptt", fwd_length=5, back_length=5)
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    # learnable sequence task: predict input class at each step (identity)
+    rng = np.random.default_rng(7)
+    idx = rng.integers(0, 4, (8, 20))
+    x = np.eye(4, dtype=np.float32)[idx]
+    y = x.copy()
+    ds = DataSet(x, y)
+    s0 = net.score_dataset(ds)
+    net.fit(ds, num_epochs=30)
+    # 20 timesteps / 5 per window = 4 updates per epoch
+    assert net.iteration == 30 * 4
+    assert net.score_dataset(ds) < s0 * 0.5
+
+
+def test_embedding_sequence_char_model():
+    """Char-RNN shape smoke (BASELINE configs[2] direction): embedding ->
+    LSTM -> per-step softmax."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(8).updater(Adam(1e-2)).weight_init("xavier").list()
+            .layer(EmbeddingSequenceLayer(n_in=11, n_out=8))
+            .layer(LSTM(n_out=16, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=11, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(11))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(9)
+    seq = rng.integers(0, 11, (4, 15))
+    x = seq.astype(np.float32)
+    y = np.eye(11, dtype=np.float32)[np.roll(seq, -1, axis=1)]
+    ds = DataSet(x, y)
+    s0 = net.score_dataset(ds)
+    net.fit(ds, num_epochs=10)
+    assert net.score_dataset(ds) < s0
+
+
+def test_last_time_step_plus_dense():
+    net = _rnn_net([LastTimeStep(layer=LSTM(n_out=6, activation="tanh")),
+                    OutputLayer(n_out=2, loss="mcxent")],
+                   InputType.recurrent(3))
+    x = np.random.default_rng(10).standard_normal((3, 7, 3)).astype(np.float32)
+    out = net.output(x)
+    assert out.shape == (3, 2)
+
+
+def test_last_time_step_respects_mask():
+    net = _rnn_net([LastTimeStep(layer=LSTM(n_out=4, activation="tanh")),
+                    OutputLayer(n_out=2, loss="mcxent")],
+                   InputType.recurrent(3))
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((1, 5, 3)).astype(np.float32)
+    mask = np.array([[1, 1, 1, 0, 0]], np.float32)
+    import jax.numpy as jnp
+    acts_m, _, _, _, _ = net._forward(net.params, net.state, jnp.asarray(x),
+                                      False, None, jnp.asarray(mask))
+    acts_s, _, _, _, _ = net._forward(net.params, net.state, jnp.asarray(x[:, :3]),
+                                      False, None, None)
+    np.testing.assert_allclose(np.asarray(acts_m[-1]), np.asarray(acts_s[-1]),
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_bidirectional_modes_and_json():
+    for mode, width in (("concat", 8), ("add", 4), ("average", 4), ("mul", 4)):
+        conf = (NeuralNetConfiguration.builder().seed(1).list()
+                .layer(Bidirectional(layer=LSTM(n_out=4, activation="tanh"), mode=mode))
+                .layer(RnnOutputLayer(n_out=2, loss="mcxent"))
+                .set_input_type(InputType.recurrent(3)).build())
+        assert conf.layer_input_types()[1].size == width
+        assert MultiLayerConfiguration.from_json(conf.to_json()) == conf
+        net = MultiLayerNetwork(conf).init()
+        out = net.output(np.random.default_rng(0).standard_normal((2, 5, 3)).astype(np.float32))
+        assert out.shape == (2, 5, 2)
+
+
+def test_embedding_layer_lookup():
+    net = _rnn_net([EmbeddingLayer(n_in=10, n_out=4),
+                    OutputLayer(n_out=3, loss="mcxent")],
+                   InputType.feed_forward(10))
+    idx = np.array([[1], [5], [9]], np.float32)
+    out = net.output(idx)
+    assert out.shape == (3, 3)
+    # same index -> same embedding row -> same output
+    out2 = net.output(np.array([[1], [1], [1]], np.float32))
+    np.testing.assert_allclose(out2[0], out2[1], rtol=1e-6)
+
+
+def test_tbptt_dispatch_for_index_sequences():
+    """Regression: 2-D (batch, time) integer features (EmbeddingSequenceLayer)
+    must still dispatch to tBPTT windows, and rnn_time_step must treat 2-D
+    index input as a sequence (found in TPU verification)."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12).updater(Adam(1e-2)).weight_init("xavier").list()
+            .layer(EmbeddingSequenceLayer(n_in=5, n_out=4))
+            .layer(LSTM(n_out=6, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=5, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(5))
+            .backprop_type("tbptt", fwd_length=4, back_length=4)
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    seq = rng.integers(0, 5, (2, 12))
+    x = seq.astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[seq]
+    net.fit(DataSet(x, y), num_epochs=1)
+    assert net.iteration == 3  # 12 / 4 windows
+    net.rnn_clear_previous_state()
+    out = net.rnn_time_step(x[:, :6])
+    assert out.shape == (2, 6, 5)
+    out1 = net.rnn_time_step(np.array([0.0, 1.0]))  # 1-D single step
+    assert out1.shape == (2, 5)
+    with pytest.raises(ValueError):
+        net.rnn_time_step(x[:1, :3])  # batch change without clear
+
+
+def test_bidirectional_rnn_time_step_raises():
+    """Reference parity: GravesBidirectionalLSTM.rnnTimeStep throws."""
+    net = _rnn_net([Bidirectional(layer=LSTM(n_out=4, activation="tanh")),
+                    RnnOutputLayer(n_out=2, loss="mcxent")],
+                   InputType.recurrent(3))
+    with pytest.raises(NotImplementedError):
+        net.rnn_time_step(np.zeros((1, 3), np.float32))
