@@ -25,3 +25,4 @@ from deeplearning4j_tpu.nn.conf import (  # noqa: F401
     InputType,
 )
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401
+from deeplearning4j_tpu.nn.graph import ComputationGraph  # noqa: F401

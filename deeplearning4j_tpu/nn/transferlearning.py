@@ -1,0 +1,144 @@
+"""Transfer learning: freeze/replace/append layers on trained networks.
+
+Parity surface: reference
+deeplearning4j-nn/.../nn/transferlearning/TransferLearning.java (847 LoC,
+Builder API), FineTuneConfiguration.java, TransferLearningHelper.java.
+
+Freezing is expressed as a per-layer ``NoOp`` updater (the mechanism the
+reference's FrozenLayer uses underneath), so the frozen layers still live
+inside the single jit-compiled train step — XLA dead-code-eliminates their
+update math.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax
+
+from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.updaters import NoOp, Updater
+
+
+@dataclasses.dataclass(frozen=True)
+class FineTuneConfiguration:
+    """Global overrides applied to all non-frozen layers (reference
+    FineTuneConfiguration.java)."""
+
+    updater: Optional[Updater] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None
+    weight_init: Optional[str] = None
+    seed: Optional[int] = None
+
+    def _apply(self, layer):
+        updates = {}
+        for f in ("updater", "l1", "l2", "dropout", "weight_init"):
+            v = getattr(self, f)
+            if v is not None and hasattr(layer, f):
+                updates[f] = v
+        return dataclasses.replace(layer, **updates) if updates else layer
+
+
+class TransferLearning:
+    """Entry point mirroring ``new TransferLearning.Builder(net)``."""
+
+    class Builder:
+        def __init__(self, net: MultiLayerNetwork):
+            if net.params is None:
+                net.init()
+            self._net = net
+            self._layers = list(net.conf.layers)
+            self._keep_params: List[bool] = [True] * len(self._layers)
+            self._frozen_upto = -1
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, layer_index: int):
+            """Freeze layers [0..layer_index] (reference setFeatureExtractor)."""
+            self._frozen_upto = layer_index
+            return self
+
+        def remove_output_layer(self):
+            return self.remove_layers_from_output(1)
+
+        def remove_layers_from_output(self, n: int):
+            for _ in range(n):
+                self._layers.pop()
+                self._keep_params.pop()
+            return self
+
+        def add_layer(self, layer):
+            self._layers.append(layer)
+            self._keep_params.append(False)
+            return self
+
+        def n_out_replace(self, layer_index: int, n_out: int,
+                          weight_init: Optional[str] = None):
+            """Replace layer's n_out, re-initializing it and widening the next
+            layer's n_in (reference nOutReplace)."""
+            layer = self._layers[layer_index]
+            updates = {"n_out": n_out}
+            if weight_init is not None:
+                updates["weight_init"] = weight_init
+            self._layers[layer_index] = dataclasses.replace(layer, **updates)
+            self._keep_params[layer_index] = False
+            if layer_index + 1 < len(self._layers):
+                nxt = self._layers[layer_index + 1]
+                if hasattr(nxt, "n_in"):
+                    self._layers[layer_index + 1] = dataclasses.replace(nxt, n_in=None)
+                    self._keep_params[layer_index + 1] = False
+            return self
+
+        def build(self) -> MultiLayerNetwork:
+            layers = []
+            for i, layer in enumerate(self._layers):
+                if i <= self._frozen_upto:
+                    if hasattr(layer, "updater"):
+                        layer = dataclasses.replace(layer, updater=NoOp())
+                elif self._fine_tune is not None:
+                    layer = self._fine_tune._apply(layer)
+                layers.append(layer)
+            old = self._net.conf
+            conf = dataclasses.replace(
+                old, layers=tuple(layers),
+                seed=(self._fine_tune.seed if self._fine_tune and
+                      self._fine_tune.seed is not None else old.seed),
+                updater=(self._fine_tune.updater if self._fine_tune and
+                         self._fine_tune.updater is not None else old.updater))
+            new_net = MultiLayerNetwork(conf).init()
+            # copy retained params (reference: params view copy in build())
+            for i, keep in enumerate(self._keep_params):
+                if keep and i < len(self._net.params):
+                    src = self._net.params[i]
+                    dst = new_net.params[i]
+                    if jax.tree_util.tree_structure(src) == jax.tree_util.tree_structure(dst):
+                        shapes_match = all(
+                            a.shape == b.shape for a, b in zip(
+                                jax.tree_util.tree_leaves(src),
+                                jax.tree_util.tree_leaves(dst)))
+                        if shapes_match:
+                            new_net.params[i] = jax.tree_util.tree_map(lambda a: a, src)
+                            new_net.state[i] = jax.tree_util.tree_map(
+                                lambda a: a, self._net.state[i])
+            return new_net
+
+
+class TransferLearningHelper:
+    """Featurize-through-frozen-layers helper (reference
+    TransferLearningHelper.java): split at the frozen boundary and train only
+    the unfrozen tail on pre-computed features."""
+
+    def __init__(self, net: MultiLayerNetwork, frozen_upto: int):
+        self._net = net
+        self._split = frozen_upto + 1
+
+    def featurize(self, x):
+        acts = self._net.feed_forward(x)
+        return acts[self._split - 1]

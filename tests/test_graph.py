@@ -1,0 +1,247 @@
+"""ComputationGraph + transfer learning tests.
+
+Mirrors the reference's GradientCheckTestsComputationGraph.java,
+ComputationGraphTestRNN / TestComputationGraphNetwork, and
+TransferLearning tests in deeplearning4j-core/src/test and deeplearning4j-nn.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.recurrent import LSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.conf.graph import (
+    GraphBuilder, ComputationGraphConfiguration, MergeVertex, ElementWiseVertex,
+    SubsetVertex, StackVertex, UnstackVertex, ScaleVertex, ShiftVertex,
+    L2NormalizeVertex, L2Vertex, LastTimeStepVertex, DuplicateToTimeSeriesVertex,
+    ReshapeVertex,
+)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.transferlearning import (
+    TransferLearning, FineTuneConfiguration,
+)
+from deeplearning4j_tpu.optimize.updaters import Adam, NoOp, Sgd
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets import IrisDataSetIterator
+
+
+def simple_graph(seed=42):
+    return (GraphBuilder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=12, activation="relu"), "in")
+            .add_layer("d2", DenseLayer(n_out=12, activation="tanh"), "in")
+            .add_vertex("merge", MergeVertex(), "d1", "d2")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent", updater=Adam(0.02)), "merge")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+
+
+def test_topological_order_and_shapes():
+    conf = simple_graph()
+    order = conf.topological_order()
+    assert order.index("merge") > order.index("d1")
+    assert order.index("merge") > order.index("d2")
+    assert order.index("out") > order.index("merge")
+    types = conf.vertex_input_types()
+    assert types["out"][0].flat_size() == 24
+
+
+def test_cycle_detection():
+    conf = ComputationGraphConfiguration(
+        network_inputs=("in",),
+        vertices={"a": (DenseLayer(n_out=4), ("b",)),
+                  "b": (DenseLayer(n_out=4), ("a",))},
+        network_outputs=("a",),
+        input_types=(InputType.feed_forward(4),))
+    with pytest.raises(ValueError, match="cycle"):
+        conf.topological_order()
+
+
+def test_graph_trains_on_iris():
+    g = ComputationGraph(simple_graph()).init()
+    it = IrisDataSetIterator(batch=50)
+    ds = next(iter(IrisDataSetIterator(batch=150)))
+    s0 = g.score_dataset(ds)
+    for _ in range(60):
+        for b in it:
+            g._fit_batch(g._get_jitted("train"), MultiDataSet.from_dataset(b))
+    assert g.score_dataset(ds) < s0 * 0.5
+    acc = (g.predict(ds.features) == np.argmax(ds.labels, -1)).mean()
+    assert acc > 0.9
+
+
+def test_graph_json_round_trip():
+    conf = simple_graph()
+    back = ComputationGraphConfiguration.from_json(conf.to_json())
+    assert back == conf
+
+
+def test_multi_input_multi_output():
+    conf = (GraphBuilder()
+            .add_inputs("a", "b")
+            .add_layer("da", DenseLayer(n_out=8, activation="relu"), "a")
+            .add_layer("db", DenseLayer(n_out=8, activation="relu"), "b")
+            .add_vertex("sum", ElementWiseVertex(op="add"), "da", "db")
+            .add_layer("out1", OutputLayer(n_out=2, loss="mcxent"), "sum")
+            .add_layer("out2", OutputLayer(n_out=1, activation="identity",
+                                           loss="mse"), "sum")
+            .set_outputs("out1", "out2")
+            .set_input_types(InputType.feed_forward(3), InputType.feed_forward(5))
+            .build())
+    g = ComputationGraph(conf).init()
+    rng = np.random.default_rng(0)
+    xa = rng.random((6, 3), np.float32)
+    xb = rng.random((6, 5), np.float32)
+    outs = g.output(xa, xb)
+    assert outs[0].shape == (6, 2) and outs[1].shape == (6, 1)
+    mds = MultiDataSet([xa, xb],
+                       [np.eye(2, dtype=np.float32)[rng.integers(0, 2, 6)],
+                        rng.random((6, 1), np.float32)])
+    s0 = g.score_dataset(mds)
+    g.fit(mds, num_epochs=40)
+    assert g.score_dataset(mds) < s0
+
+
+def test_vertices_forward_semantics():
+    import jax.numpy as jnp
+    x = jnp.asarray(np.arange(12, dtype=np.float32).reshape(2, 6))
+    assert np.allclose(SubsetVertex(1, 3).apply(x), np.asarray(x)[:, 1:4])
+    assert np.allclose(ScaleVertex(2.0).apply(x), 2 * np.asarray(x))
+    assert np.allclose(ShiftVertex(1.0).apply(x), np.asarray(x) + 1)
+    st = StackVertex().apply(x, x)
+    assert st.shape == (4, 6)
+    un = UnstackVertex(1, 2).apply(st)
+    assert np.allclose(un, np.asarray(x))
+    n = L2NormalizeVertex().apply(x)
+    assert np.allclose(np.linalg.norm(np.asarray(n), axis=1), 1.0, atol=1e-4)
+    d = L2Vertex().apply(x, x + 3.0)
+    assert np.allclose(np.asarray(d), np.sqrt(6 * 9), atol=1e-3)
+    r = ReshapeVertex(shape=(3, 2)).apply(x)
+    assert r.shape == (2, 3, 2)
+    ew = ElementWiseVertex("max").apply(x, -x)
+    assert np.allclose(ew, np.abs(np.asarray(x)))
+
+
+def test_seq2seq_style_graph():
+    """LastTimeStepVertex + DuplicateToTimeSeriesVertex (reference rnn vertices)."""
+    conf = (GraphBuilder()
+            .add_inputs("seq")
+            .add_layer("enc", LSTM(n_out=8, activation="tanh"), "seq")
+            .add_vertex("last", LastTimeStepVertex(), "enc")
+            .add_vertex("dup", DuplicateToTimeSeriesVertex(reference_input="seq"), "last")
+            .add_layer("dec", LSTM(n_out=8, activation="tanh"), "dup")
+            .add_layer("out", RnnOutputLayer(n_out=3, loss="mcxent",
+                                             updater=Adam(0.01)), "dec")
+            .set_outputs("out")
+            .set_input_types(InputType.recurrent(5))
+            .build())
+    g = ComputationGraph(conf).init()
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((3, 7, 5)).astype(np.float32)
+    out = g.output_single(x)
+    assert out.shape == (3, 7, 3)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (3, 7))]
+    mds = MultiDataSet([x], [y])
+    s0 = g.score_dataset(mds)
+    g.fit(mds, num_epochs=20)
+    assert g.score_dataset(mds) < s0
+
+
+def test_graph_gradcheck_merge():
+    """Reference: GradientCheckTestsComputationGraph.java (merge topology).
+    Uses the graph's own loss function with finite differences."""
+    import jax
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+    from jax import enable_x64
+
+    conf = (GraphBuilder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=4, activation="tanh", updater=NoOp()), "in")
+            .add_layer("d2", DenseLayer(n_out=4, activation="sigmoid", updater=NoOp()), "in")
+            .add_vertex("merge", MergeVertex(), "d1", "d2")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent", updater=NoOp()), "merge")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(3))
+            .build())
+    g = ComputationGraph(conf).init()
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((3, 3)).astype(np.float64)
+    y = np.eye(3)[rng.integers(0, 3, 3)].astype(np.float64)
+    with enable_x64():
+        params64 = jax.tree_util.tree_map(lambda a: jnp.asarray(np.float64(a)), g.params)
+        state64 = jax.tree_util.tree_map(lambda a: jnp.asarray(np.float64(a)), g.state)
+        flat0, unravel = ravel_pytree(params64)
+
+        def loss_flat(flat):
+            return g._loss_fn(unravel(flat), state64, [jnp.asarray(x)],
+                              [jnp.asarray(y)], None, None, None)[0]
+
+        analytic = np.asarray(jax.grad(loss_flat)(flat0))
+        loss_jit = jax.jit(loss_flat)
+        fl = np.asarray(flat0)
+        eps = 1e-6
+        worst = 0.0
+        for i in range(len(fl)):
+            fp, fm = fl.copy(), fl.copy()
+            fp[i] += eps
+            fm[i] -= eps
+            num = (float(loss_jit(jnp.asarray(fp))) - float(loss_jit(jnp.asarray(fm)))) / (2 * eps)
+            denom = max(abs(analytic[i]), abs(num), 1e-12)
+            worst = max(worst, abs(analytic[i] - num) / denom)
+        assert worst < 1e-3, worst
+
+
+def test_transfer_learning_freeze_and_replace():
+    """Reference: TransferLearning.Builder — freeze feature extractor, replace
+    output layer, fine-tune."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater(Adam(0.02)).weight_init("xavier").list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    base = MultiLayerNetwork(conf).init()
+    base.fit(IrisDataSetIterator(batch=50), num_epochs=30)
+    w0 = np.asarray(base.params[0]["W"]).copy()
+
+    new_net = (TransferLearning.Builder(base)
+               .fine_tune_configuration(FineTuneConfiguration(updater=Adam(0.01)))
+               .set_feature_extractor(0)           # freeze first dense
+               .remove_output_layer()
+               .add_layer(OutputLayer(n_out=3, activation="softmax",
+                                      loss="mcxent", n_in=8))
+               .build())
+    # frozen layer got the base's trained params
+    np.testing.assert_allclose(np.asarray(new_net.params[0]["W"]), w0)
+    new_net.fit(IrisDataSetIterator(batch=50), num_epochs=20)
+    # frozen layer unchanged, trainable layer moved
+    np.testing.assert_allclose(np.asarray(new_net.params[0]["W"]), w0)
+    ds = next(iter(IrisDataSetIterator(batch=150)))
+    acc = (new_net.predict(ds.features) == np.argmax(ds.labels, -1)).mean()
+    assert acc > 0.85
+
+
+def test_transfer_learning_nout_replace():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(4).updater(Adam(0.02)).list()
+            .layer(DenseLayer(n_out=10, activation="relu"))
+            .layer(OutputLayer(n_out=3, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    base = MultiLayerNetwork(conf).init()
+    new_net = (TransferLearning.Builder(base)
+               .n_out_replace(0, 20)
+               .build())
+    assert new_net.params[0]["W"].shape == (4, 20)
+    assert new_net.params[1]["W"].shape == (20, 3)
+    out = new_net.output(np.ones((2, 4), np.float32))
+    assert out.shape == (2, 3)
