@@ -1,0 +1,16 @@
+from deeplearning4j_tpu.earlystopping.trainer import (  # noqa: F401
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    EarlyStoppingResult,
+)
+from deeplearning4j_tpu.earlystopping.conditions import (  # noqa: F401
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+    InvalidScoreIterationTerminationCondition,
+)
+from deeplearning4j_tpu.earlystopping.savers import (  # noqa: F401
+    InMemoryModelSaver,
+    LocalFileModelSaver,
+)

@@ -1,0 +1,72 @@
+"""Model savers for early stopping.
+
+Parity surface: reference earlystopping/saver/{InMemoryModelSaver,
+LocalFileModelSaver,LocalFileGraphSaver}.java.
+
+Snapshots are copied to HOST memory (``jax.device_get``): the train steps use
+``donate_argnums``, so aliasing the live device buffers would leave the saved
+"best model" pointing at deleted arrays after the next fit() step.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def _clone_model(template):
+    """Fresh model object of the template's class/conf (params overwritten by
+    the caller) — get_best_model must not mutate the live training model."""
+    model = type(template)(template.conf)
+    model.init()
+    return model
+
+
+class InMemoryModelSaver:
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    @staticmethod
+    def _snapshot(model):
+        # device_get: host copies, immune to later buffer donation
+        return (jax.device_get(model.params), jax.device_get(model.state))
+
+    def save_best_model(self, model, score):
+        self._best = (self._snapshot(model), score)
+
+    def save_latest_model(self, model, score):
+        self._latest = (self._snapshot(model), score)
+
+    def get_best_model(self, template):
+        if self._best is None:
+            return None
+        (params, state), _ = self._best
+        model = _clone_model(template)
+        model.params, model.state = params, state
+        return model
+
+
+class LocalFileModelSaver:
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, name):
+        return os.path.join(self.directory, name)
+
+    def save_best_model(self, model, score):
+        from deeplearning4j_tpu.utils.serialization import write_model
+        write_model(model, self._path("bestModel.zip"))
+
+    def save_latest_model(self, model, score):
+        from deeplearning4j_tpu.utils.serialization import write_model
+        write_model(model, self._path("latestModel.zip"))
+
+    def get_best_model(self, template=None):
+        from deeplearning4j_tpu.utils.serialization import restore
+        path = self._path("bestModel.zip")
+        if not os.path.exists(path):
+            return None
+        return restore(path)

@@ -1,0 +1,129 @@
+"""Early-stopping trainer.
+
+Parity surface: reference earlystopping/EarlyStoppingConfiguration.java,
+trainer/BaseEarlyStoppingTrainer.java: per-epoch score on a validation set,
+best-model tracking via a saver, iteration + epoch termination conditions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+from typing import List, Optional
+
+from deeplearning4j_tpu.earlystopping.conditions import (
+    EpochTerminationCondition, IterationTerminationCondition,
+)
+from deeplearning4j_tpu.earlystopping.savers import InMemoryModelSaver
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class EarlyStoppingConfiguration:
+    epoch_termination_conditions: List[EpochTerminationCondition]
+    iteration_termination_conditions: List[IterationTerminationCondition] = \
+        dataclasses.field(default_factory=list)
+    model_saver: object = dataclasses.field(default_factory=InMemoryModelSaver)
+    evaluate_every_n_epochs: int = 1
+    save_last_model: bool = False
+
+
+@dataclasses.dataclass
+class EarlyStoppingResult:
+    termination_reason: str  # "epoch_condition" | "iteration_condition" | "error"
+    termination_details: str
+    total_epochs: int
+    best_model_epoch: int
+    best_model_score: float
+    score_vs_epoch: dict
+    best_model: object
+
+
+class EarlyStoppingTrainer:
+    """Drive fit() epoch-by-epoch with validation scoring (see module doc).
+
+    ``score_calculator``: callable(model) -> float; defaults to loss on the
+    validation iterator (reference scorecalc/DataSetLossCalculator.java).
+    """
+
+    def __init__(self, config: EarlyStoppingConfiguration, model,
+                 train_data, validation_data=None, score_calculator=None):
+        self.config = config
+        self.model = model
+        self.train_data = train_data
+        self.validation_data = validation_data
+        if score_calculator is None:
+            if validation_data is None:
+                raise ValueError("Need validation_data or a score_calculator")
+
+            def score_calculator(m):
+                from deeplearning4j_tpu.datasets.dataset import DataSet
+                total, n = 0.0, 0
+                for ds in self.validation_data:
+                    total += m.score_dataset(ds) * ds.num_examples()
+                    n += ds.num_examples()
+                return total / max(n, 1)
+        self.score_calculator = score_calculator
+
+    def fit(self) -> EarlyStoppingResult:
+        for c in (self.config.epoch_termination_conditions
+                  + self.config.iteration_termination_conditions):
+            c.initialize()
+        best_score = math.inf
+        best_epoch = -1
+        score_vs_epoch = {}
+        epoch = 0
+        reason, details = "epoch_condition", ""
+        while True:
+            # --- one epoch of training with iteration-condition checks ---
+            stop_iter = None
+            for ds in self.train_data:
+                self.model.fit(ds)
+                s = self.model.score()
+                for cond in self.config.iteration_termination_conditions:
+                    if cond.terminate(s):
+                        stop_iter = cond
+                        break
+                if stop_iter is not None:
+                    break
+            if stop_iter is not None:
+                reason = "iteration_condition"
+                details = type(stop_iter).__name__
+                break
+            # --- validation scoring (every N epochs) ---
+            score = None
+            if epoch % self.config.evaluate_every_n_epochs == 0:
+                score = float(self.score_calculator(self.model))
+                score_vs_epoch[epoch] = score
+                if score < best_score:
+                    best_score = score
+                    best_epoch = epoch
+                    self.config.model_saver.save_best_model(self.model, score)
+                if self.config.save_last_model:
+                    self.config.model_saver.save_latest_model(self.model, score)
+            # --- epoch termination: checked EVERY epoch (reference
+            # BaseEarlyStoppingTrainer), with the most recent score ---
+            last_score = score if score is not None else (
+                min(score_vs_epoch.values()) if score_vs_epoch else float("inf"))
+            stop_epoch = None
+            for cond in self.config.epoch_termination_conditions:
+                if cond.terminate(epoch, last_score):
+                    stop_epoch = cond
+                    break
+            if stop_epoch is not None:
+                details = type(stop_epoch).__name__
+                epoch += 1
+                break
+            epoch += 1
+        best_model = self.config.model_saver.get_best_model(self.model)
+        return EarlyStoppingResult(
+            termination_reason=reason,
+            termination_details=details,
+            total_epochs=epoch,
+            best_model_epoch=best_epoch,
+            best_model_score=best_score,
+            score_vs_epoch=score_vs_epoch,
+            best_model=best_model,
+        )

@@ -1,0 +1,168 @@
+"""Classification evaluation.
+
+Parity surface: reference deeplearning4j-nn/.../eval/Evaluation.java
+(:285 eval(realOutcomes, guesses), :499 stats(), :1031 f1(), :1138 accuracy()),
+ConfusionMatrix.java, EvaluationBinary.java.
+
+Metric accumulation is a host-side numpy confusion matrix (cheap); the heavy
+part — the forward pass producing predictions — runs jit-compiled on device.
+Mask-aware for time-series (reference: time-series eval with label masks).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class ConfusionMatrix:
+    """reference eval/ConfusionMatrix.java"""
+
+    def __init__(self, n_classes: int):
+        self.matrix = np.zeros((n_classes, n_classes), np.int64)
+
+    def add(self, actual: np.ndarray, predicted: np.ndarray):
+        np.add.at(self.matrix, (actual, predicted), 1)
+
+    def get_count(self, actual: int, predicted: int) -> int:
+        return int(self.matrix[actual, predicted])
+
+
+class Evaluation:
+    """Accuracy / precision / recall / F1 / confusion matrix (see module doc)."""
+
+    def __init__(self, n_classes: Optional[int] = None, labels: Optional[List[str]] = None):
+        self.n_classes = n_classes
+        self.label_names = labels
+        self.confusion: Optional[ConfusionMatrix] = None
+
+    def _ensure(self, n):
+        if self.confusion is None:
+            if self.n_classes is not None and self.n_classes != n:
+                raise ValueError(
+                    f"Batch has {n} classes; evaluation was constructed with "
+                    f"n_classes={self.n_classes}")
+            self.n_classes = n
+            self.confusion = ConfusionMatrix(n)
+        elif n != self.n_classes:
+            raise ValueError(
+                f"Batch has {n} classes; previous batches had {self.n_classes}")
+
+    def eval(self, labels, predictions, mask=None):
+        """Accumulate a batch (reference Evaluation.eval :285). ``labels`` is
+        one-hot (batch, n) or (batch, time, n); ``predictions`` are
+        probabilities of the same shape; ``mask`` (batch,) or (batch, time)."""
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        self._ensure(labels.shape[-1])
+        actual = np.argmax(labels.reshape(-1, labels.shape[-1]), axis=-1)
+        pred = np.argmax(predictions.reshape(-1, predictions.shape[-1]), axis=-1)
+        if mask is not None:
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            actual, pred = actual[m], pred[m]
+        self.confusion.add(actual, pred)
+
+    # ---- metrics ----
+    def _tp(self, i):
+        return self.confusion.matrix[i, i]
+
+    def _fp(self, i):
+        return self.confusion.matrix[:, i].sum() - self._tp(i)
+
+    def _fn(self, i):
+        return self.confusion.matrix[i, :].sum() - self._tp(i)
+
+    def accuracy(self) -> float:
+        m = self.confusion.matrix
+        total = m.sum()
+        return float(np.trace(m) / total) if total else 0.0
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        if cls is not None:
+            d = self._tp(cls) + self._fp(cls)
+            return float(self._tp(cls) / d) if d else 0.0
+        vals = [self.precision(i) for i in range(self.n_classes)
+                if (self.confusion.matrix[i, :].sum() + self.confusion.matrix[:, i].sum()) > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        if cls is not None:
+            d = self._tp(cls) + self._fn(cls)
+            return float(self._tp(cls) / d) if d else 0.0
+        vals = [self.recall(i) for i in range(self.n_classes)
+                if self.confusion.matrix[i, :].sum() > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        """reference Evaluation.f1 :1031"""
+        p = self.precision(cls)
+        r = self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def false_positive_rate(self, cls: int) -> float:
+        m = self.confusion.matrix
+        tn = m.sum() - m[cls, :].sum() - m[:, cls].sum() + m[cls, cls]
+        fp = self._fp(cls)
+        return float(fp / (fp + tn)) if (fp + tn) else 0.0
+
+    def stats(self) -> str:
+        """Human-readable summary (reference Evaluation.stats :499)."""
+        names = self.label_names or [str(i) for i in range(self.n_classes)]
+        lines = ["========================Evaluation Metrics========================",
+                 f" # of classes:    {self.n_classes}",
+                 f" Accuracy:        {self.accuracy():.4f}",
+                 f" Precision:       {self.precision():.4f}",
+                 f" Recall:          {self.recall():.4f}",
+                 f" F1 Score:        {self.f1():.4f}",
+                 "", "=========================Confusion Matrix=========================="]
+        m = self.confusion.matrix
+        header = "      " + " ".join(f"{n:>6}" for n in names)
+        lines.append(header)
+        for i, row in enumerate(m):
+            lines.append(f"{names[i]:>6}" + " ".join(f"{v:>6}" for v in row))
+        lines.append("===================================================================")
+        return "\n".join(lines)
+
+
+class EvaluationBinary:
+    """Per-output binary metrics for multi-label outputs (reference
+    eval/EvaluationBinary.java)."""
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+        self.tp = None
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels).reshape(-1, np.asarray(labels).shape[-1])
+        preds = (np.asarray(predictions).reshape(labels.shape) >= self.threshold)
+        lab = labels >= 0.5
+        if mask is not None:
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            lab, preds = lab[m], preds[m]
+        if self.tp is None:
+            n = labels.shape[-1]
+            self.tp = np.zeros(n, np.int64)
+            self.fp = np.zeros(n, np.int64)
+            self.tn = np.zeros(n, np.int64)
+            self.fn = np.zeros(n, np.int64)
+        self.tp += (lab & preds).sum(0)
+        self.fp += (~lab & preds).sum(0)
+        self.tn += (~lab & ~preds).sum(0)
+        self.fn += (lab & ~preds).sum(0)
+
+    def accuracy(self, i: int) -> float:
+        tot = self.tp[i] + self.fp[i] + self.tn[i] + self.fn[i]
+        return float((self.tp[i] + self.tn[i]) / tot) if tot else 0.0
+
+    def precision(self, i: int) -> float:
+        d = self.tp[i] + self.fp[i]
+        return float(self.tp[i] / d) if d else 0.0
+
+    def recall(self, i: int) -> float:
+        d = self.tp[i] + self.fn[i]
+        return float(self.tp[i] / d) if d else 0.0
+
+    def f1(self, i: int) -> float:
+        p, r = self.precision(i), self.recall(i)
+        return 2 * p * r / (p + r) if (p + r) else 0.0

@@ -1,0 +1,121 @@
+"""ROC / AUC.
+
+Parity surface: reference eval/ROC.java (706 LoC; exact mode with
+thresholdSteps=0 and thresholded mode), ROCBinary.java, ROCMultiClass.java.
+
+This implementation accumulates raw (score, label) pairs (the reference's
+"exact" mode, the default since 0.9.x) and computes AUROC by rank statistics
+and AUPRC by trapezoidal integration of the PR curve.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class ROC:
+    """Binary ROC: positive class probability vs binary label."""
+
+    def __init__(self):
+        self._scores: List[np.ndarray] = []
+        self._labels: List[np.ndarray] = []
+
+    def eval(self, labels, predictions, mask=None):
+        """labels: (n,) {0,1} or one-hot (n,2) (positive = column 1);
+        predictions: same shape of probabilities."""
+        labels = np.asarray(labels)
+        preds = np.asarray(predictions)
+        if labels.ndim > 1 and labels.shape[-1] == 2:
+            labels = labels[..., 1]
+            preds = preds[..., 1]
+        labels = labels.reshape(-1)
+        preds = preds.reshape(-1)
+        if mask is not None:
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            labels, preds = labels[m], preds[m]
+        self._labels.append(labels.astype(np.float64))
+        self._scores.append(preds.astype(np.float64))
+
+    def _collect(self) -> Tuple[np.ndarray, np.ndarray]:
+        return np.concatenate(self._scores), np.concatenate(self._labels)
+
+    def calculate_auc(self) -> float:
+        """AUROC via the Mann-Whitney U statistic (rank sum), equivalent to
+        the reference's exact-mode trapezoidal AUC."""
+        s, y = self._collect()
+        pos = s[y > 0.5]
+        neg = s[y <= 0.5]
+        if len(pos) == 0 or len(neg) == 0:
+            return float("nan")
+        order = np.argsort(np.concatenate([pos, neg]), kind="mergesort")
+        ranks = np.empty(len(order))
+        ranks[order] = np.arange(1, len(order) + 1)
+        # average ranks for ties
+        allv = np.concatenate([pos, neg])
+        sorted_v = allv[order]
+        i = 0
+        while i < len(sorted_v):
+            j = i
+            while j + 1 < len(sorted_v) and sorted_v[j + 1] == sorted_v[i]:
+                j += 1
+            if j > i:
+                avg = (i + 1 + j + 1) / 2.0
+                ranks[order[i:j + 1]] = avg
+            i = j + 1
+        r_pos = ranks[:len(pos)].sum()
+        u = r_pos - len(pos) * (len(pos) + 1) / 2.0
+        return float(u / (len(pos) * len(neg)))
+
+    def calculate_auprc(self) -> float:
+        s, y = self._collect()
+        order = np.argsort(-s, kind="mergesort")
+        y = y[order] > 0.5
+        tp = np.cumsum(y)
+        fp = np.cumsum(~y)
+        precision = tp / np.maximum(tp + fp, 1)
+        recall = tp / max(y.sum(), 1)
+        # prepend (recall=0, precision=1)
+        recall = np.concatenate([[0.0], recall])
+        precision = np.concatenate([[1.0], precision])
+        return float(np.trapezoid(precision, recall))
+
+    def get_roc_curve(self, num_points: int = 101):
+        """(fpr, tpr) arrays at score thresholds (reference curves/RocCurve)."""
+        s, y = self._collect()
+        thresholds = np.linspace(1.0, 0.0, num_points)
+        pos = max((y > 0.5).sum(), 1)
+        neg = max((y <= 0.5).sum(), 1)
+        tpr = [(s[y > 0.5] >= t).sum() / pos for t in thresholds]
+        fpr = [(s[y <= 0.5] >= t).sum() / neg for t in thresholds]
+        return np.asarray(fpr), np.asarray(tpr)
+
+
+class ROCMultiClass:
+    """One-vs-all ROC per class (reference ROCMultiClass.java)."""
+
+    def __init__(self):
+        self._rocs: Optional[List[ROC]] = None
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        preds = np.asarray(predictions)
+        n = labels.shape[-1]
+        if self._rocs is None:
+            self._rocs = [ROC() for _ in range(n)]
+        lab2 = labels.reshape(-1, n)
+        pr2 = preds.reshape(-1, n)
+        if mask is not None:
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            lab2, pr2 = lab2[m], pr2[m]
+        for i in range(n):
+            self._rocs[i].eval(lab2[:, i], pr2[:, i])
+
+    def calculate_auc(self, cls: int) -> float:
+        return self._rocs[cls].calculate_auc()
+
+    def calculate_average_auc(self) -> float:
+        vals = [r.calculate_auc() for r in self._rocs]
+        vals = [v for v in vals if not np.isnan(v)]
+        return float(np.mean(vals)) if vals else float("nan")

@@ -1,0 +1,133 @@
+"""Model serialization: save/restore networks as a single zip file.
+
+Parity surface: reference deeplearning4j-nn/.../util/ModelSerializer.java
+(:37 class, :52 writeModel — config JSON + params + updater state,
+:137+ restoreMultiLayerNetwork / restoreComputationGraph).
+
+Zip layout mirrors the reference's:
+- ``configuration.json``  — network config (our JSON schema)
+- ``coefficients.npz``    — flat numpy archive of all params
+- ``updaterState.npz``    — optimizer state (saved when save_updater=True)
+- ``metadata.json``       — model class, iteration/epoch counters, format version
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Union
+
+import jax
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _path_key(path) -> str:
+    parts = []
+    for p in path:
+        for attr in ("key", "idx", "name"):
+            if hasattr(p, attr):
+                parts.append(str(getattr(p, attr)))
+                break
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _flatten_with_paths(tree):
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {_path_key(path): np.asarray(leaf) for path, leaf in flat}
+
+
+def _save_npz_bytes(arrays: dict) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _restore_into(tree, arrays: dict):
+    """Rebuild a pytree with the same structure, leaves taken from arrays."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    leaves = []
+    for path, leaf in flat:
+        key = _path_key(path)
+        if key not in arrays:
+            raise ValueError(f"Missing array '{key}' in checkpoint")
+        saved = arrays[key]
+        if tuple(saved.shape) != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"Shape mismatch for '{key}': checkpoint {saved.shape} vs model "
+                f"{np.shape(leaf)}")
+        leaves.append(saved.astype(np.asarray(leaf).dtype))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def write_model(model, path: str, save_updater: bool = True):
+    """reference ModelSerializer.writeModel :52"""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    if model.params is None:
+        model.init()
+    if isinstance(model, MultiLayerNetwork):
+        model_type = "MultiLayerNetwork"
+    elif isinstance(model, ComputationGraph):
+        model_type = "ComputationGraph"
+    else:
+        raise TypeError(f"Cannot serialize {type(model)}")
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "model_type": model_type,
+        "iteration": model.iteration,
+        "epoch": model.epoch,
+        "has_updater": bool(save_updater),
+    }
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("configuration.json", model.conf.to_json())
+        z.writestr("metadata.json", json.dumps(meta))
+        z.writestr("coefficients.npz",
+                   _save_npz_bytes(_flatten_with_paths([model.params, model.state])))
+        if save_updater:
+            z.writestr("updaterState.npz",
+                       _save_npz_bytes(_flatten_with_paths(model.opt_state)))
+
+
+def restore_multi_layer_network(path: str, load_updater: bool = True):
+    """reference ModelSerializer.restoreMultiLayerNetwork :137"""
+    return _restore(path, expect="MultiLayerNetwork", load_updater=load_updater)
+
+
+def restore_computation_graph(path: str, load_updater: bool = True):
+    return _restore(path, expect="ComputationGraph", load_updater=load_updater)
+
+
+def restore(path: str, load_updater: bool = True):
+    return _restore(path, expect=None, load_updater=load_updater)
+
+
+def _restore(path, expect, load_updater):
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.conf.graph import ComputationGraphConfiguration
+    with zipfile.ZipFile(path, "r") as z:
+        meta = json.loads(z.read("metadata.json"))
+        if expect is not None and meta["model_type"] != expect:
+            raise ValueError(
+                f"Checkpoint holds a {meta['model_type']}, not a {expect}")
+        cfg_json = z.read("configuration.json").decode()
+        if meta["model_type"] == "MultiLayerNetwork":
+            model = MultiLayerNetwork(MultiLayerConfiguration.from_json(cfg_json))
+        else:
+            model = ComputationGraph(ComputationGraphConfiguration.from_json(cfg_json))
+        model.init()
+        coeff = dict(np.load(io.BytesIO(z.read("coefficients.npz"))))
+        params, state = _restore_into([model.params, model.state], coeff)
+        model.params, model.state = params, state
+        if load_updater and meta.get("has_updater") and "updaterState.npz" in z.namelist():
+            upd = dict(np.load(io.BytesIO(z.read("updaterState.npz"))))
+            model.opt_state = _restore_into(model.opt_state, upd)
+        model.iteration = meta.get("iteration", 0)
+        model.epoch = meta.get("epoch", 0)
+    return model

@@ -1,0 +1,288 @@
+"""Evaluation metrics, ROC, early stopping, and model serialization tests.
+
+Mirrors reference suites: deeplearning4j-core/src/test/.../eval/ (EvalTest,
+ROCTest, RegressionEvalTest), earlystopping/TestEarlyStopping.java, and the
+ModelSerializer round-trip tests (util/ModelSerializerTest.java).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval import Evaluation, EvaluationBinary, RegressionEvaluation, ROC, ROCMultiClass
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.recurrent import LSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.conf.graph import GraphBuilder, MergeVertex
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.updaters import Adam
+from deeplearning4j_tpu.datasets import IrisDataSetIterator
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.earlystopping import (
+    EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    MaxEpochsTerminationCondition, ScoreImprovementEpochTerminationCondition,
+    InvalidScoreIterationTerminationCondition, LocalFileModelSaver,
+)
+from deeplearning4j_tpu.utils.serialization import (
+    write_model, restore, restore_multi_layer_network, restore_computation_graph,
+)
+
+
+# ---------------------------------------------------------------- Evaluation
+def test_evaluation_known_values():
+    """Hand-checkable confusion matrix (reference EvalTest pattern)."""
+    e = Evaluation()
+    labels = np.eye(3)[[0, 0, 1, 1, 2, 2]]
+    # predictions: one error (last class-2 example called class 0)
+    preds = np.eye(3)[[0, 0, 1, 1, 2, 0]] * 0.9 + 0.05
+    e.eval(labels, preds)
+    assert e.accuracy() == pytest.approx(5 / 6)
+    assert e.recall(2) == pytest.approx(0.5)
+    assert e.precision(0) == pytest.approx(2 / 3)
+    assert e.confusion.get_count(2, 0) == 1
+    assert "Accuracy" in e.stats()
+
+
+def test_evaluation_with_mask():
+    e = Evaluation()
+    labels = np.eye(2)[[0, 1, 1]]
+    preds = np.eye(2)[[0, 0, 0]]
+    mask = np.array([1, 1, 0], np.float32)  # third example ignored
+    e.eval(labels, preds, mask=mask)
+    assert e.confusion.matrix.sum() == 2
+    assert e.accuracy() == pytest.approx(0.5)
+
+
+def test_evaluation_binary():
+    e = EvaluationBinary()
+    labels = np.array([[1, 0], [1, 1], [0, 0], [0, 1]], np.float32)
+    preds = np.array([[0.9, 0.2], [0.8, 0.4], [0.3, 0.1], [0.2, 0.9]], np.float32)
+    e.eval(labels, preds)
+    assert e.accuracy(0) == pytest.approx(1.0)
+    assert e.recall(1) == pytest.approx(0.5)
+
+
+def test_regression_evaluation():
+    e = RegressionEvaluation()
+    rng = np.random.default_rng(0)
+    y = rng.random((50, 2))
+    pred = y + 0.1  # constant offset
+    e.eval(y, pred)
+    assert e.mean_absolute_error(0) == pytest.approx(0.1, abs=1e-6)
+    assert e.mean_squared_error(1) == pytest.approx(0.01, abs=1e-6)
+    assert e.pearson_correlation(0) == pytest.approx(1.0, abs=1e-6)
+    assert "MSE" in e.stats()
+
+
+def test_roc_auc_perfect_and_random():
+    roc = ROC()
+    labels = np.array([0, 0, 0, 1, 1, 1])
+    perfect = np.array([0.1, 0.2, 0.3, 0.7, 0.8, 0.9])
+    roc.eval(labels, perfect)
+    assert roc.calculate_auc() == pytest.approx(1.0)
+    assert roc.calculate_auprc() == pytest.approx(1.0, abs=1e-6)
+
+    roc2 = ROC()
+    rng = np.random.default_rng(1)
+    labels = rng.integers(0, 2, 4000)
+    roc2.eval(labels, rng.random(4000))
+    assert roc2.calculate_auc() == pytest.approx(0.5, abs=0.05)
+
+
+def test_roc_ties_handled():
+    roc = ROC()
+    roc.eval(np.array([0, 1, 0, 1]), np.array([0.5, 0.5, 0.5, 0.5]))
+    assert roc.calculate_auc() == pytest.approx(0.5)
+
+
+def test_roc_multiclass():
+    r = ROCMultiClass()
+    labels = np.eye(3)[[0, 1, 2, 0, 1, 2]]
+    preds = labels * 0.8 + 0.1
+    r.eval(labels, preds)
+    assert r.calculate_average_auc() == pytest.approx(1.0)
+
+
+def test_network_evaluate_api():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(0.02)).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(IrisDataSetIterator(batch=50), num_epochs=60)
+    e = net.evaluate(IrisDataSetIterator(batch=50))
+    assert e.accuracy() > 0.9
+    assert e.f1() > 0.85
+
+
+# ------------------------------------------------------------- Early stopping
+def _iris_net(lr=0.02, seed=5):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Adam(lr)).list()
+            .layer(DenseLayer(n_out=12, activation="relu"))
+            .layer(OutputLayer(n_out=3, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_early_stopping_max_epochs():
+    net = _iris_net()
+    esc = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(5)])
+    result = EarlyStoppingTrainer(esc, net, IrisDataSetIterator(batch=50),
+                                  IrisDataSetIterator(batch=150)).fit()
+    assert result.total_epochs == 5
+    assert result.termination_details == "MaxEpochsTerminationCondition"
+    assert result.best_model is not None
+    assert result.best_model_score < 1.2
+
+
+def test_early_stopping_score_improvement():
+    net = _iris_net(lr=0.05)
+    esc = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[
+            MaxEpochsTerminationCondition(200),
+            ScoreImprovementEpochTerminationCondition(3, min_improvement=1e-4)])
+    result = EarlyStoppingTrainer(esc, net, IrisDataSetIterator(batch=150),
+                                  IrisDataSetIterator(batch=150)).fit()
+    assert result.total_epochs < 200
+    assert result.best_model_score <= min(result.score_vs_epoch.values()) + 1e-9
+
+
+def test_early_stopping_invalid_score_guard():
+    net = _iris_net(lr=1e6)  # diverges to NaN quickly
+    esc = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(50)],
+        iteration_termination_conditions=[InvalidScoreIterationTerminationCondition()])
+    result = EarlyStoppingTrainer(esc, net, IrisDataSetIterator(batch=50),
+                                  IrisDataSetIterator(batch=150)).fit()
+    assert result.termination_reason in ("iteration_condition", "epoch_condition")
+
+
+# -------------------------------------------------------------- Serialization
+def test_mln_round_trip(tmp_path):
+    net = _iris_net()
+    net.fit(IrisDataSetIterator(batch=50), num_epochs=10)
+    path = os.path.join(tmp_path, "model.zip")
+    write_model(net, path)
+    back = restore_multi_layer_network(path)
+    x = np.random.default_rng(0).random((5, 4), np.float32)
+    np.testing.assert_allclose(back.output(x), net.output(x), rtol=1e-6)
+    assert back.iteration == net.iteration
+    # updater state restored: further training gives identical results
+    ds = next(iter(IrisDataSetIterator(batch=150)))
+    net.fit(ds)
+    back.fit(ds)
+    np.testing.assert_allclose(back.output(x), net.output(x), rtol=1e-5, atol=1e-6)
+
+
+def test_graph_round_trip(tmp_path):
+    conf = (GraphBuilder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=8, activation="relu"), "in")
+            .add_layer("d2", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_vertex("m", MergeVertex(), "d1", "d2")
+            .add_layer("out", OutputLayer(n_out=3, loss="mcxent", updater=Adam(0.02)), "m")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4)).build())
+    g = ComputationGraph(conf).init()
+    ds = next(iter(IrisDataSetIterator(batch=150)))
+    g.fit(ds, num_epochs=5)
+    path = os.path.join(tmp_path, "graph.zip")
+    write_model(g, path)
+    back = restore_computation_graph(path)
+    x = ds.features[:7]
+    np.testing.assert_allclose(back.output_single(x), g.output_single(x), rtol=1e-6)
+
+
+def test_restore_wrong_type_raises(tmp_path):
+    net = _iris_net()
+    path = os.path.join(tmp_path, "model.zip")
+    write_model(net, path)
+    with pytest.raises(ValueError, match="not a"):
+        restore_computation_graph(path)
+
+
+def test_local_file_saver(tmp_path):
+    net = _iris_net()
+    esc = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+        model_saver=LocalFileModelSaver(str(tmp_path)))
+    result = EarlyStoppingTrainer(esc, net, IrisDataSetIterator(batch=50),
+                                  IrisDataSetIterator(batch=150)).fit()
+    assert os.path.exists(os.path.join(tmp_path, "bestModel.zip"))
+    assert result.best_model is not None
+
+
+def test_rnn_model_round_trip(tmp_path):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(2).updater(Adam(0.01)).list()
+            .layer(LSTM(n_out=6, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=2, loss="mcxent"))
+            .set_input_type(InputType.recurrent(3)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(1).standard_normal((2, 5, 3)).astype(np.float32)
+    path = os.path.join(tmp_path, "rnn.zip")
+    write_model(net, path)
+    back = restore(path)
+    np.testing.assert_allclose(back.output(x), net.output(x), rtol=1e-6)
+
+
+def test_in_memory_saver_survives_donation():
+    """Regression (review): snapshots must be host copies — the train step
+    donates param buffers, so an aliased snapshot dies on the next fit()."""
+    from deeplearning4j_tpu.earlystopping.savers import InMemoryModelSaver
+    net = _iris_net()
+    ds = next(iter(IrisDataSetIterator(batch=150)))
+    net.fit(ds)
+    saver = InMemoryModelSaver()
+    saver.save_best_model(net, net.score())
+    expected = None
+    net.fit(ds)  # donates the old buffers
+    best = saver.get_best_model(net)
+    out = best.output(ds.features[:5])  # must not raise "Array has been deleted"
+    assert out.shape == (5, 3)
+    # and the live model was not mutated by get_best_model
+    assert best is not net
+
+
+def test_early_stopping_epoch_cap_exact_with_sparse_eval():
+    """Regression (review): MaxEpochs must not overshoot when
+    evaluate_every_n_epochs > 1."""
+    net = _iris_net()
+    esc = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(4)],
+        evaluate_every_n_epochs=2)
+    result = EarlyStoppingTrainer(esc, net, IrisDataSetIterator(batch=150),
+                                  IrisDataSetIterator(batch=150)).fit()
+    assert result.total_epochs == 4
+
+
+def test_local_file_saver_no_best_returns_none(tmp_path):
+    from deeplearning4j_tpu.earlystopping.savers import LocalFileModelSaver
+    saver = LocalFileModelSaver(str(tmp_path))
+    assert saver.get_best_model() is None
+
+
+def test_graph_auto_preprocessor_cnn_to_dense():
+    """Regression (review): a conv vertex feeding a dense layer must get an
+    automatic CnnToFeedForward preprocessor like the sequential config."""
+    from deeplearning4j_tpu.nn.conf.convolutional import ConvolutionLayer
+    conf = (GraphBuilder()
+            .add_inputs("img")
+            .add_layer("conv", ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                                activation="relu"), "img")
+            .add_layer("fc", DenseLayer(n_out=10, activation="relu"), "conv")
+            .add_layer("out", OutputLayer(n_out=3, loss="mcxent", updater=Adam(0.01)), "fc")
+            .set_outputs("out")
+            .set_input_types(InputType.convolutional(8, 8, 1))
+            .build())
+    g = ComputationGraph(conf).init()
+    assert g.vertices["fc"][0].n_in == 6 * 6 * 4
+    x = np.random.default_rng(0).random((2, 8, 8, 1), np.float32)
+    out = g.output_single(x)
+    assert out.shape == (2, 3)
+    g.fit(DataSet(x, np.eye(3, dtype=np.float32)[[0, 1]]), num_epochs=2)
