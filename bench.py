@@ -1,0 +1,72 @@
+"""Benchmark: LeNet MNIST training throughput on one TPU chip.
+
+BASELINE configs[0] ("LeNet MultiLayerNetwork on MNIST, single chip"). The
+reference repo publishes no numbers (BASELINE.md); ``vs_baseline`` is
+reported against a nominal V100 nd4j-cuda LeNet throughput estimate so the
+ratio is meaningful across rounds.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# The reference publishes no LeNet numbers; this is the driver-era nominal
+# V100 figure used as the fixed denominator across rounds.
+NOMINAL_V100_LENET_IMGS_PER_SEC = 10_000.0
+
+BATCH = 256
+WARMUP_STEPS = 10
+MEASURE_STEPS = 300
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.datasets.fetchers import synthetic_mnist
+    from deeplearning4j_tpu.models import LeNet
+
+    net = LeNet(num_classes=10).init()
+    x_np, y_np = synthetic_mnist(BATCH * 4, seed=7)
+    step = net._get_jitted("train")
+
+    batches = []
+    for i in range(4):
+        sl = slice(i * BATCH, (i + 1) * BATCH)
+        batches.append((jnp.asarray(x_np[sl]), jnp.asarray(y_np[sl])))
+
+    def run_one(i):
+        x, y = batches[i % len(batches)]
+        net._rng, k = jax.random.split(net._rng)
+        net.params, net.state, net.opt_state, loss = step(
+            net.params, net.state, net.opt_state, k, x, y, None, None)
+        return loss
+
+    for i in range(WARMUP_STEPS):
+        run_one(i)
+    jax.block_until_ready(net.params)
+
+    # steps pipeline asynchronously; blocking on the params chain at the end
+    # measures sustained device throughput (per-step host sync would measure
+    # tunnel round-trip latency instead)
+    t0 = time.perf_counter()
+    for i in range(MEASURE_STEPS):
+        run_one(i)
+    jax.block_until_ready(net.params)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = MEASURE_STEPS * BATCH / dt
+    print(json.dumps({
+        "metric": "lenet_mnist_train_imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec, 1),
+        "unit": "imgs/sec",
+        "vs_baseline": round(imgs_per_sec / NOMINAL_V100_LENET_IMGS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

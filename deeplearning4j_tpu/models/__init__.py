@@ -1,0 +1,8 @@
+from deeplearning4j_tpu.models.zoo import ZooModel  # noqa: F401
+from deeplearning4j_tpu.models.lenet import LeNet  # noqa: F401
+from deeplearning4j_tpu.models.simplecnn import SimpleCNN  # noqa: F401
+from deeplearning4j_tpu.models.alexnet import AlexNet  # noqa: F401
+from deeplearning4j_tpu.models.vgg import VGG16, VGG19  # noqa: F401
+from deeplearning4j_tpu.models.resnet50 import ResNet50  # noqa: F401
+from deeplearning4j_tpu.models.darknet import Darknet19, TinyYOLO  # noqa: F401
+from deeplearning4j_tpu.models.textgenlstm import TextGenerationLSTM  # noqa: F401

@@ -1,0 +1,53 @@
+"""AlexNet (reference zoo/model/AlexNet.java — the one-weird-trick variant:
+conv11x11/4 -> LRN -> pool -> conv5x5 -> LRN -> pool -> 3x conv3x3 -> pool ->
+2x dense(4096)+dropout -> softmax)."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.models.zoo import ZooModel
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.convolutional import ConvolutionLayer, SubsamplingLayer
+from deeplearning4j_tpu.nn.conf.normalization import LocalResponseNormalization
+from deeplearning4j_tpu.optimize.updaters import Nesterovs
+
+
+class AlexNet(ZooModel):
+    input_shape = (224, 224, 3)
+
+    def __init__(self, num_classes: int = 1000, seed: int = 12345, input_shape=None,
+                 updater=None):
+        super().__init__(num_classes, seed, input_shape)
+        self.updater = updater or Nesterovs(learning_rate=1e-2, momentum=0.9)
+
+    def conf(self):
+        h, w, c = self.input_shape
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed).updater(self.updater).weight_init("normal")
+                .list()
+                .layer(ConvolutionLayer(n_out=96, kernel_size=(11, 11), stride=(4, 4),
+                                        activation="relu"))
+                .layer(LocalResponseNormalization())
+                .layer(SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=256, kernel_size=(5, 5),
+                                        convolution_mode="same", activation="relu",
+                                        bias_init=1.0))
+                .layer(LocalResponseNormalization())
+                .layer(SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3),
+                                        convolution_mode="same", activation="relu"))
+                .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3),
+                                        convolution_mode="same", activation="relu",
+                                        bias_init=1.0))
+                .layer(ConvolutionLayer(n_out=256, kernel_size=(3, 3),
+                                        convolution_mode="same", activation="relu",
+                                        bias_init=1.0))
+                .layer(SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)))
+                .layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5,
+                                  bias_init=1.0))
+                .layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5,
+                                  bias_init=1.0))
+                .layer(OutputLayer(n_out=self.num_classes, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())

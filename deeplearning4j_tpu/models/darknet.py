@@ -1,0 +1,108 @@
+"""Darknet19 + TinyYOLO backbones (reference zoo/model/Darknet19.java,
+TinyYOLO.java). TinyYOLO's detection head (Yolo2OutputLayer) lands with the
+object-detection layer family; until then the model exposes the conv backbone
+with a classification head."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.models.zoo import ZooModel
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, InputType
+from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+from deeplearning4j_tpu.nn.conf.convolutional import ConvolutionLayer, SubsamplingLayer
+from deeplearning4j_tpu.nn.conf.normalization import BatchNormalization
+from deeplearning4j_tpu.nn.conf.pooling import GlobalPoolingLayer
+from deeplearning4j_tpu.optimize.updaters import Nesterovs
+
+
+def _dark_conv(b, n_out, kernel=(3, 3)):
+    b = b.layer(ConvolutionLayer(n_out=n_out, kernel_size=kernel,
+                                 convolution_mode="same", has_bias=False,
+                                 activation="identity"))
+    b = b.layer(BatchNormalization())
+    from deeplearning4j_tpu.nn.conf.layers import ActivationLayer
+    return b.layer(ActivationLayer(activation="leakyrelu"))
+
+
+class Darknet19(ZooModel):
+    input_shape = (224, 224, 3)
+
+    def __init__(self, num_classes: int = 1000, seed: int = 12345, input_shape=None,
+                 updater=None):
+        super().__init__(num_classes, seed, input_shape)
+        self.updater = updater or Nesterovs(learning_rate=1e-3, momentum=0.9)
+
+    def conf(self):
+        h, w, c = self.input_shape
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(self.updater).weight_init("relu")
+             .list())
+        b = _dark_conv(b, 32)
+        b = b.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        b = _dark_conv(b, 64)
+        b = b.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        for n in (128, 256, 512):
+            b = _dark_conv(b, n)
+            b = _dark_conv(b, n // 2, kernel=(1, 1))
+            b = _dark_conv(b, n)
+            b = b.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        b = _dark_conv(b, 1024)
+        b = _dark_conv(b, 512, kernel=(1, 1))
+        b = _dark_conv(b, 1024)
+        b = _dark_conv(b, 512, kernel=(1, 1))
+        b = _dark_conv(b, 1024)
+        return (b.layer(GlobalPoolingLayer(pooling_type="avg"))
+                 .layer(OutputLayer(n_out=self.num_classes, activation="softmax",
+                                    loss="mcxent"))
+                 .set_input_type(InputType.convolutional(h, w, c))
+                 .build())
+
+
+class TinyYOLO(ZooModel):
+    """Tiny YOLO backbone (reference zoo/model/TinyYOLO.java). The
+    Yolo2OutputLayer detection head is attached by ``detection_conf`` once the
+    objdetect layer family is available; ``conf`` builds the backbone with a
+    classification head for feature training."""
+
+    input_shape = (416, 416, 3)
+
+    def __init__(self, num_classes: int = 20, seed: int = 12345, input_shape=None,
+                 updater=None):
+        super().__init__(num_classes, seed, input_shape)
+        self.updater = updater or Nesterovs(learning_rate=1e-3, momentum=0.9)
+
+    def backbone(self, b):
+        for i, n in enumerate((16, 32, 64, 128, 256)):
+            b = _dark_conv(b, n)
+            b = b.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        b = _dark_conv(b, 512)
+        b = b.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(1, 1),
+                                     convolution_mode="same"))
+        b = _dark_conv(b, 1024)
+        return b
+
+    def conf(self):
+        h, w, c = self.input_shape
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(self.updater).weight_init("relu")
+             .list())
+        b = self.backbone(b)
+        return (b.layer(GlobalPoolingLayer(pooling_type="avg"))
+                 .layer(OutputLayer(n_out=self.num_classes, activation="softmax",
+                                    loss="mcxent"))
+                 .set_input_type(InputType.convolutional(h, w, c))
+                 .build())
+
+    def detection_conf(self, boxes):
+        """Full detection config with Yolo2OutputLayer (see objdetect module)."""
+        from deeplearning4j_tpu.nn.conf.objdetect import Yolo2OutputLayer
+        from deeplearning4j_tpu.nn.conf.convolutional import ConvolutionLayer
+        h, w, c = self.input_shape
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(self.updater).weight_init("relu")
+             .list())
+        b = self.backbone(b)
+        n_anchors = len(boxes)
+        b = b.layer(ConvolutionLayer(n_out=n_anchors * (5 + self.num_classes),
+                                     kernel_size=(1, 1), activation="identity"))
+        b = b.layer(Yolo2OutputLayer(boxes=tuple(tuple(x) for x in boxes)))
+        return b.set_input_type(InputType.convolutional(h, w, c)).build()

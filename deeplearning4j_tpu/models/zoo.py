@@ -1,0 +1,64 @@
+"""Model zoo base.
+
+Parity surface: reference deeplearning4j-zoo/.../zoo/ZooModel.java:23
+(abstract base with init()/pretrained-weight loading at :40-52) and
+zoo/model/* (LeNet, AlexNet, VGG16/19, ResNet50, Darknet19, TinyYOLO,
+SimpleCNN, TextGenerationLSTM, GoogLeNet, InceptionResNetV1,
+FaceNetNN4Small2).
+
+Pretrained-weight download is gated: this environment has zero egress, so
+``init_pretrained`` loads from a local checkpoint path when provided
+(``DL4J_TPU_PRETRAINED_DIR``) and raises a clear error otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+
+class ZooModel:
+    """Base for zoo models: ``conf()`` builds the network configuration,
+    ``init()`` returns an initialized network."""
+
+    def __init__(self, num_classes: int = 1000, seed: int = 12345,
+                 input_shape: Optional[Tuple[int, ...]] = None):
+        self.num_classes = num_classes
+        self.seed = seed
+        if input_shape is not None:
+            self.input_shape = input_shape
+
+    def conf(self):
+        raise NotImplementedError
+
+    def init(self):
+        """Build + initialize (reference ZooModel.init())."""
+        from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.conf.graph import ComputationGraphConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        c = self.conf()
+        if isinstance(c, MultiLayerConfiguration):
+            return MultiLayerNetwork(c).init()
+        if isinstance(c, ComputationGraphConfiguration):
+            return ComputationGraph(c).init()
+        raise TypeError(type(c))
+
+    def pretrained_checkpoint(self) -> Optional[str]:
+        d = os.environ.get("DL4J_TPU_PRETRAINED_DIR")
+        if not d:
+            return None
+        path = os.path.join(d, f"{type(self).__name__.lower()}.zip")
+        return path if os.path.exists(path) else None
+
+    def init_pretrained(self):
+        """reference ZooModel.initPretrained :40-52 (download+checksum there;
+        local checkpoint here — zero-egress environment)."""
+        path = self.pretrained_checkpoint()
+        if path is None:
+            raise FileNotFoundError(
+                f"No pretrained checkpoint for {type(self).__name__}: set "
+                "DL4J_TPU_PRETRAINED_DIR to a directory holding "
+                f"{type(self).__name__.lower()}.zip (no network egress here)")
+        from deeplearning4j_tpu.utils.serialization import restore
+        return restore(path)

@@ -1,0 +1,98 @@
+"""Ring attention — sequence/context parallelism over the device mesh.
+
+The reference has NO attention and no sequence parallelism (SURVEY §5
+long-context: only tBPTT + masking). This module is the framework's
+long-context story, built TPU-first:
+
+- sequences are sharded over a mesh axis (time axis of (b, h, t, d));
+- each device holds one Q block and streams K/V blocks around the ring with
+  ``lax.ppermute`` (neighbour exchanges ride the ICI torus);
+- softmax is accumulated online (flash-attention style log-sum-exp rescaling),
+  so the full (t, t) score matrix never materializes — memory is O(t_local^2)
+  per device and sequence length scales linearly with the number of devices.
+
+`ring_self_attention` is the public entry: a shard_map'd function usable under
+jit and differentiable (autodiff traces through ppermute; the backward pass
+performs the reverse ring).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def reference_attention(q, k, v, causal: bool = False):
+    """Plain full-matrix attention (numerical reference / single-device path).
+    Shapes: (batch, heads, time, head_dim)."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(d).astype(q.dtype)
+    if causal:
+        t_q, t_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool))
+        scores = jnp.where(mask, scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+
+def _ring_block(q, k, v, axis_name: str, causal: bool):
+    """Per-device body under shard_map: q/k/v are the LOCAL time blocks."""
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    t_local = q.shape[2]
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(d).astype(q.dtype)
+
+    q_pos = my * t_local + jnp.arange(t_local)              # global q positions
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(i, carry):
+        k_cur, v_cur, num, denom, maxv = carry
+        src = (my - i) % n                                   # whose K/V block this is
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_cur) * scale
+        if causal:
+            k_pos = src * t_local + jnp.arange(t_local)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        block_max = jnp.max(scores, axis=-1)                 # (b,h,tq)
+        new_max = jnp.maximum(maxv, block_max)
+        # guard -inf rows (fully masked block): exp(-inf - -inf) -> use where
+        correction = jnp.exp(jnp.where(jnp.isinf(maxv) & jnp.isinf(new_max),
+                                       0.0, maxv - new_max))
+        p = jnp.exp(jnp.where(jnp.isinf(scores),
+                              -jnp.inf, scores - new_max[..., None]))
+        p = jnp.where(jnp.isnan(p), 0.0, p)
+        num = num * correction[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_cur)
+        denom = denom * correction + jnp.sum(p, axis=-1)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, num, denom, new_max)
+
+    num0 = jnp.zeros_like(q)
+    denom0 = jnp.zeros(q.shape[:-1], q.dtype)
+    max0 = jnp.full(q.shape[:-1], -jnp.inf, q.dtype)
+    # unrolled python loop: n is static (mesh size), keeps ppermute schedule
+    # explicit for XLA overlap
+    carry = (k, v, num0, denom0, max0)
+    for i in range(n):
+        carry = step(i, carry)
+    _, _, num, denom, _ = carry
+    return num / jnp.maximum(denom[..., None], 1e-30)
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = "data",
+                        causal: bool = False):
+    """Sequence-parallel attention: (b, h, t, d) with t sharded over
+    ``axis_name``. Drop-in equal (up to float tolerance) to
+    ``reference_attention`` on the gathered sequence."""
+    spec = P(None, None, axis_name, None)
+    f = jax.shard_map(
+        functools.partial(_ring_block, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return f(q, k, v)

@@ -1,0 +1,194 @@
+"""Parallelism tests on the 8-device virtual CPU mesh.
+
+Mirrors the reference's run-distributed-without-a-cluster strategy
+(ParallelWrapperTest on CPU, BaseSparkTest local[N] — SURVEY §4.3/§4.4):
+same code paths as real multi-chip, worker count > physical devices.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.updaters import Adam, Sgd
+from deeplearning4j_tpu.datasets import IrisDataSetIterator
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.parallel import ParallelWrapper, ParallelInference, ClusterTrainer
+from deeplearning4j_tpu.parallel.mesh import make_mesh, tp_shardings, DATA_AXIS, MODEL_AXIS
+from deeplearning4j_tpu.parallel.ring_attention import (
+    reference_attention, ring_self_attention,
+)
+
+
+def _net(seed=42, lr=0.05):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Sgd(learning_rate=lr)).weight_init("xavier").list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _iris_batch(n=144):
+    ds = next(iter(IrisDataSetIterator(batch=150)))
+    return DataSet(ds.features[:n], ds.labels[:n])
+
+
+def test_mesh_construction(devices):
+    mesh = make_mesh()
+    assert mesh.shape[DATA_AXIS] == 8 and mesh.shape[MODEL_AXIS] == 1
+    mesh2 = make_mesh(tp=2)
+    assert mesh2.shape[DATA_AXIS] == 4 and mesh2.shape[MODEL_AXIS] == 2
+    with pytest.raises(ValueError):
+        make_mesh(dp=5, tp=2)
+
+
+def test_data_parallel_matches_single_device(devices):
+    """DP training over the mesh must produce the SAME params as single-device
+    training on the same global batch (exact per-step averaging — the
+    semantics ParallelWrapper.averagingFrequency=1 only approximates)."""
+    ds = _iris_batch(144)
+    single = _net(seed=7)
+    single.fit(ds, num_epochs=5)
+
+    dp = _net(seed=7)
+    pw = ParallelWrapper(dp, mesh=make_mesh())
+    pw.fit(ds, num_epochs=5)
+
+    for a, b in zip(jax.tree_util.tree_leaves(single.params),
+                    jax.tree_util.tree_leaves(dp.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6)
+
+
+def test_data_parallel_batch_is_sharded(devices):
+    ds = _iris_batch(144)
+    net = _net()
+    pw = ParallelWrapper(net, mesh=make_mesh())
+    sharded = pw._shard_dataset(ds)
+    assert len(sharded.features.sharding.device_set) == 8
+
+
+def test_data_parallel_rejects_ragged_batch(devices):
+    net = _net()
+    pw = ParallelWrapper(net, mesh=make_mesh())
+    with pytest.raises(ValueError, match="divisible"):
+        pw.fit(_iris_batch(150))  # 150 % 8 != 0
+
+
+def test_tensor_parallel_trains_and_shards_params(devices):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater(Adam(0.02)).weight_init("xavier").list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=4, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    mesh = make_mesh(tp=2)  # dp=4, tp=2
+    pw = ParallelWrapper(net, mesh=mesh, tensor_parallel=True)
+    rng = np.random.default_rng(0)
+    x = rng.random((16, 4), np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+    ds = DataSet(x, y)
+    s0 = net.score_dataset(ds)
+    pw.fit(ds, num_epochs=30)
+    # the (4,32) kernel is actually sharded over 'model'
+    spec = net.params[0]["W"].sharding.spec
+    assert MODEL_AXIS in str(spec)
+    with pw.mesh:
+        assert net.score_dataset(pw._shard_dataset(ds)) < s0 * 0.7
+
+
+def test_tp_matches_replicated_numerics(devices):
+    """Tensor-parallel step == replicated step (GSPMD is semantics-preserving)."""
+    rng = np.random.default_rng(1)
+    x = rng.random((8, 4), np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    ds = DataSet(x, y)
+    a = _net(seed=11)
+    b = _net(seed=11)
+    ParallelWrapper(a, mesh=make_mesh()).fit(ds, num_epochs=3)
+    ParallelWrapper(b, mesh=make_mesh(tp=4), tensor_parallel=True).fit(ds, num_epochs=3)
+    for la, lb in zip(jax.tree_util.tree_leaves(a.params),
+                      jax.tree_util.tree_leaves(b.params)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=2e-4, atol=1e-5)
+
+
+def test_parallel_inference_pads_ragged(devices):
+    net = _net()
+    pi = ParallelInference(net, mesh=make_mesh())
+    x = np.random.default_rng(0).random((13, 4), np.float32)  # 13 % 8 != 0
+    out = pi.output(x)
+    assert out.shape == (13, 3)
+    np.testing.assert_allclose(out, net.output(x), rtol=1e-5, atol=1e-6)
+
+
+def test_parallel_inference_batched_queue(devices):
+    net = _net()
+    pi = ParallelInference(net, mesh=make_mesh())
+    x = np.random.default_rng(1).random((4, 4), np.float32)
+    out = pi.output_batched(x)
+    assert out.shape == (4, 3)
+
+
+def test_cluster_trainer_single_process(devices):
+    ClusterTrainer.initialize(num_processes=1)  # no-op path
+    net = _net(seed=13)
+    ct = ClusterTrainer(net, mesh=make_mesh())
+    ds = _iris_batch(144)
+    s0 = net.score_dataset(ds)
+    ct.fit_local_shard(ds, num_epochs=10)
+    with ct.mesh:
+        assert net.score_dataset(ct._shard_dataset(ds)) < s0
+
+
+# ------------------------------------------------------------- ring attention
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_reference(devices, causal):
+    mesh = make_mesh()  # 8-way sequence sharding on 'data'
+    rng = np.random.default_rng(5)
+    b, h, t, d = 2, 3, 32, 8  # t=32 -> 4 timesteps per device
+    q = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    expected = reference_attention(q, k, v, causal=causal)
+    got = ring_self_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_differentiable(devices):
+    mesh = make_mesh()
+    rng = np.random.default_rng(6)
+    b, h, t, d = 1, 2, 16, 4
+    q = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_self_attention(q, k, v, mesh, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=3e-4, atol=3e-5)
+
+
+def test_ring_attention_jit_compiles(devices):
+    mesh = make_mesh()
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((1, 1, 64, 8)), jnp.float32)
+
+    @jax.jit
+    def f(q):
+        return ring_self_attention(q, q, q, mesh, causal=True)
+
+    out = f(q)
+    assert out.shape == (1, 1, 64, 8)
