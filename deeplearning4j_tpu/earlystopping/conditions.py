@@ -22,6 +22,11 @@ class IterationTerminationCondition:
 
 
 class EpochTerminationCondition:
+    # Conditions that read the validation score are only checked on epochs
+    # where one was computed (evaluate_every_n_epochs); score-free conditions
+    # (max epochs) are checked every epoch.
+    requires_score = True
+
     def initialize(self):
         pass
 
@@ -30,6 +35,8 @@ class EpochTerminationCondition:
 
 
 class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    requires_score = False
+
     def __init__(self, max_epochs: int):
         self.max_epochs = max_epochs
 

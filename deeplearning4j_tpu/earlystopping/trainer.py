@@ -74,6 +74,7 @@ class EarlyStoppingTrainer:
         best_score = math.inf
         best_epoch = -1
         score_vs_epoch = {}
+        last_computed_score = math.inf
         epoch = 0
         reason, details = "epoch_condition", ""
         while True:
@@ -103,13 +104,17 @@ class EarlyStoppingTrainer:
                     self.config.model_saver.save_best_model(self.model, score)
                 if self.config.save_last_model:
                     self.config.model_saver.save_latest_model(self.model, score)
-            # --- epoch termination: checked EVERY epoch (reference
-            # BaseEarlyStoppingTrainer), with the most recent score ---
-            last_score = score if score is not None else (
-                min(score_vs_epoch.values()) if score_vs_epoch else float("inf"))
+            # --- epoch termination (reference BaseEarlyStoppingTrainer):
+            # score-free conditions every epoch; score-based conditions only
+            # on epochs where a validation score was actually computed, so
+            # patience counters tick per evaluation, not per epoch ---
+            if score is not None:
+                last_computed_score = score
             stop_epoch = None
             for cond in self.config.epoch_termination_conditions:
-                if cond.terminate(epoch, last_score):
+                if getattr(cond, "requires_score", True) and score is None:
+                    continue
+                if cond.terminate(epoch, last_computed_score):
                     stop_epoch = cond
                     break
             if stop_epoch is not None:

@@ -1,0 +1,101 @@
+"""HDF5 archive access for Keras files.
+
+Parity surface: reference ``keras/Hdf5Archive.java:22-25`` — there a JavaCPP
+binding to native libhdf5; here ``h5py`` (already TPU-host friendly, per
+SURVEY §2.11's external-component table).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+import numpy as np
+
+
+def _decode(v):
+    if isinstance(v, bytes):
+        return v.decode("utf-8")
+    if isinstance(v, np.ndarray) and v.dtype.kind == "S":
+        return [x.decode("utf-8") for x in v]
+    if isinstance(v, (list, np.ndarray)):
+        return [_decode(x) for x in v]
+    return v
+
+
+class Hdf5Archive:
+    """Read-only view of a Keras HDF5 file (reference Hdf5Archive.java).
+
+    Groups are addressed by a path of group names, mirroring the reference's
+    ``readAttributeAsJson(attr, ...groups)`` / ``readDataSet(name, ...groups)``.
+    """
+
+    def __init__(self, path: str):
+        import h5py
+        self._f = h5py.File(path, "r")
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _group(self, groups):
+        g = self._f
+        for name in groups:
+            g = g[name]
+        return g
+
+    def has_attribute(self, name: str, *groups: str) -> bool:
+        return name in self._group(groups).attrs
+
+    def read_attribute_as_string(self, name: str, *groups: str) -> str:
+        v = self._group(groups).attrs[name]
+        v = _decode(v)
+        if not isinstance(v, str):
+            raise TypeError(f"Attribute {name} is not a string: {type(v)}")
+        return v
+
+    def read_attribute_as_json(self, name: str, *groups: str) -> dict:
+        return json.loads(self.read_attribute_as_string(name, *groups))
+
+    def read_attribute_as_string_list(self, name: str, *groups: str) -> List[str]:
+        v = _decode(self._group(groups).attrs[name])
+        if isinstance(v, str):
+            return [v]
+        return list(v)
+
+    def read_dataset(self, name: str, *groups: str) -> np.ndarray:
+        return np.asarray(self._group(groups)[name])
+
+    def get_data_sets(self, *groups: str) -> List[str]:
+        import h5py
+        g = self._group(groups)
+        return [k for k, v in g.items() if isinstance(v, h5py.Dataset)]
+
+    def get_groups(self, *groups: str) -> List[str]:
+        import h5py
+        g = self._group(groups)
+        return [k for k, v in g.items() if isinstance(v, h5py.Group)]
+
+    def has_group(self, name: str, *groups: str) -> bool:
+        import h5py
+        g = self._group(groups)
+        return name in g and isinstance(g[name], h5py.Group)
+
+    def walk_datasets(self, *groups: str):
+        """Yield (path, ndarray) for every dataset below the group, in file
+        order — used to read layer weights without relying on exact
+        weight-name formats across Keras versions."""
+        import h5py
+        out = []
+
+        def visit(path, obj):
+            if isinstance(obj, h5py.Dataset):
+                out.append((path, np.asarray(obj)))
+
+        self._group(groups).visititems(visit)
+        return out

@@ -80,6 +80,32 @@ def layer_from_dict(d: dict):
     return cls(**kwargs)
 
 
+def resolve_param_path(params: dict, key: str):
+    """Resolve a possibly-nested '/'-separated param key (wrapper layers like
+    Bidirectional expose 'fwd/W'-style paths). Returns the array or None."""
+    node = params
+    for part in key.split("/"):
+        if isinstance(node, dict) and part in node:
+            node = node[part]
+        else:
+            return None
+    return node
+
+
+def regularization_coefficients(layer):
+    """(l1, l2, l1_bias, l2_bias) for a layer; wrapper layers (those with a
+    nested ``layer`` field) fall back to the inner layer's coefficients when
+    their own are all zero — matching the reference, where the wrapped layer's
+    conf carries the regularization."""
+    vals = (getattr(layer, "l1", 0.0) or 0.0, getattr(layer, "l2", 0.0) or 0.0,
+            getattr(layer, "l1_bias", 0.0) or 0.0,
+            getattr(layer, "l2_bias", 0.0) or 0.0)
+    inner = getattr(layer, "layer", None)
+    if inner is not None and not any(vals):
+        return regularization_coefficients(inner)
+    return vals
+
+
 def dropout_input(x, dropout: float, train: bool, rng):
     """Inverted dropout on layer input (reference: Dropout.applyDropout via
     BaseLayer.applyDropOutIfNecessary; retain-prob semantics of DL4J 0.9)."""

@@ -59,6 +59,17 @@ class MultiLayerConfiguration:
     # per-layer-index input preprocessors (reference InputPreProcessor map)
     input_preprocessors: Optional[Dict[int, object]] = None
 
+    def __post_init__(self):
+        if (self.backprop_type == "tbptt"
+                and self.tbptt_fwd_length != self.tbptt_back_length):
+            # _fit_tbptt steps and truncates by fwd_length only; silently
+            # training with a different truncation than configured would
+            # diverge from the reference's doTruncatedBPTT semantics.
+            raise ValueError(
+                "tbptt_back_length != tbptt_fwd_length is not supported: got "
+                f"fwd={self.tbptt_fwd_length}, back={self.tbptt_back_length}. "
+                "Use equal lengths")
+
     # ---- shape wiring (reference MultiLayerConfiguration getLayerActivationTypes) ----
     def layer_input_types(self) -> List[InputType]:
         """Input type *seen by each layer* after preprocessor insertion."""
@@ -235,6 +246,7 @@ class ListBuilder:
         return self
 
     def backprop_type(self, t: str, fwd_length: int = 20, back_length: int = 20) -> "ListBuilder":
+        # equal-length validation happens in MultiLayerConfiguration.__post_init__
         self._backprop_type = t
         self._tbptt_fwd = fwd_length
         self._tbptt_back = back_length

@@ -201,7 +201,11 @@ class Bidirectional(BaseRecurrentLayer):
     supports_stateful = False
 
     def regularizable(self):
-        return ()
+        # Regularize both directions' wrapped weights (the reference applies
+        # l1/l2 to fwd and bwd input+recurrent weights alike); "/"-paths are
+        # resolved into the nested param tree by the network's _regularization.
+        inner = self.layer.regularizable() if self.layer is not None else ()
+        return tuple(f"{d}/{k}" for d in ("fwd", "bwd") for k in inner)
 
     def output_type(self, it: InputType) -> InputType:
         inner = self.layer.output_type(it)
@@ -380,7 +384,8 @@ class LastTimeStep(BaseRecurrentLayer):
     layer: Optional[LSTM] = None
 
     def regularizable(self):
-        return ()
+        # params ARE the wrapped layer's params (init delegates directly)
+        return self.layer.regularizable() if self.layer is not None else ()
 
     def output_type(self, it: InputType) -> InputType:
         inner = self.layer.output_type(it)

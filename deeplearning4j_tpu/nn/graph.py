@@ -158,15 +158,17 @@ class ComputationGraph:
         return acts, preouts, new_state, mask_of
 
     def _regularization(self, params):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            regularization_coefficients, resolve_param_path,
+        )
         total = 0.0
         for name in self._layer_names:
             layer = self.vertices[name][0]
             p = params[name]
-            l1 = getattr(layer, "l1", 0.0) or 0.0
-            l2 = getattr(layer, "l2", 0.0) or 0.0
+            l1, l2, _, _ = regularization_coefficients(layer)
             for key in layer.regularizable():
-                if key in p:
-                    w = p[key]
+                w = resolve_param_path(p, key)
+                if w is not None:
                     if w.dtype in (jnp.bfloat16, jnp.float16):
                         w = w.astype(jnp.float32)
                     if l2:

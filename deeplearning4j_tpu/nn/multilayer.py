@@ -154,15 +154,15 @@ class MultiLayerNetwork:
     def _regularization(self, params):
         """L1/L2 penalty (reference BaseLayer.calcL2/calcL1; score term added in
         BaseOutputLayer.computeScore fullNetworkL1/L2)."""
+        from deeplearning4j_tpu.nn.conf.layers import (
+            regularization_coefficients, resolve_param_path,
+        )
         total = 0.0
         for layer, p in zip(self.layers, params):
-            l1 = getattr(layer, "l1", 0.0) or 0.0
-            l2 = getattr(layer, "l2", 0.0) or 0.0
-            l1b = getattr(layer, "l1_bias", 0.0) or 0.0
-            l2b = getattr(layer, "l2_bias", 0.0) or 0.0
+            l1, l2, l1b, l2b = regularization_coefficients(layer)
             for key in layer.regularizable():
-                if key in p:
-                    w = p[key]
+                w = resolve_param_path(p, key)
+                if w is not None:
                     if w.dtype in (jnp.bfloat16, jnp.float16):
                         w = w.astype(jnp.float32)
                     if l2:
@@ -390,16 +390,19 @@ class MultiLayerNetwork:
             self.iteration += 1
 
     # ---------------------------------------------------------------- output
-    def output(self, x, train: bool = False) -> np.ndarray:
-        """Inference forward pass (reference MultiLayerNetwork.output :1947)."""
+    def output(self, x, train: bool = False, features_mask=None) -> np.ndarray:
+        """Inference forward pass (reference MultiLayerNetwork.output :1947;
+        the 4-arg overload output(input, train, fMask, lMask) threads the
+        features mask through the forward pass)."""
         if self.params is None:
             self.init()
         fn = self._get_jitted("output")
-        return np.asarray(fn(self.params, self.state, jnp.asarray(x), None))
+        fm = None if features_mask is None else jnp.asarray(features_mask)
+        return np.asarray(fn(self.params, self.state, jnp.asarray(x), fm))
 
-    def predict(self, x) -> np.ndarray:
+    def predict(self, x, features_mask=None) -> np.ndarray:
         """Class indices (reference MultiLayerNetwork.predict)."""
-        return np.argmax(self.output(x), axis=-1)
+        return np.argmax(self.output(x, features_mask=features_mask), axis=-1)
 
     def feed_forward(self, x, train: bool = False):
         """All layer activations (reference feedForward :852)."""
@@ -421,7 +424,7 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.eval.evaluation import Evaluation
         e = Evaluation()
         for ds in iterator:
-            out = self.output(ds.features)
+            out = self.output(ds.features, features_mask=ds.features_mask)
             e.eval(ds.labels, out, mask=ds.labels_mask)
         return e
 
@@ -429,16 +432,19 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.eval.regression import RegressionEvaluation
         e = RegressionEvaluation()
         for ds in iterator:
-            out = self.output(ds.features)
+            out = self.output(ds.features, features_mask=ds.features_mask)
             e.eval(ds.labels, out, mask=ds.labels_mask)
         return e
 
     # ------------------------------------------------------------- utilities
     def clone(self) -> "MultiLayerNetwork":
+        # Deep-copy the buffers: train steps are jitted with buffer donation,
+        # so aliasing the live arrays would leave the clone holding deleted
+        # buffers after the next fit() on either network.
         other = MultiLayerNetwork(self.conf)
         if self.params is not None:
-            other.params = jax.tree_util.tree_map(lambda a: a, self.params)
-            other.state = jax.tree_util.tree_map(lambda a: a, self.state)
-            other.opt_state = jax.tree_util.tree_map(lambda a: a, self.opt_state)
+            other.params = jax.tree_util.tree_map(jnp.array, self.params)
+            other.state = jax.tree_util.tree_map(jnp.array, self.state)
+            other.opt_state = jax.tree_util.tree_map(jnp.array, self.opt_state)
             other._rng = self._rng
         return other

@@ -16,6 +16,7 @@ import dataclasses
 from typing import List, Optional
 
 import jax
+import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
@@ -124,9 +125,11 @@ class TransferLearning:
                                 jax.tree_util.tree_leaves(src),
                                 jax.tree_util.tree_leaves(dst)))
                         if shapes_match:
-                            new_net.params[i] = jax.tree_util.tree_map(lambda a: a, src)
+                            # jnp.array copies: source net's buffers are
+                            # donation targets of its own jitted train step.
+                            new_net.params[i] = jax.tree_util.tree_map(jnp.array, src)
                             new_net.state[i] = jax.tree_util.tree_map(
-                                lambda a: a, self._net.state[i])
+                                jnp.array, self._net.state[i])
             return new_net
 
 
