@@ -1,0 +1,35 @@
+"""NLP embeddings stack (reference deeplearning4j-nlp-parent, 56.4k LoC).
+
+TPU-native redesign of the SequenceVectors/Word2Vec family: the reference's
+lock-free multithreaded host SGD (SkipGram.java:156 batching into native sg/
+cbow kernels) becomes batched device steps — windows are vectorized host-side
+into (center, context) index batches, and one jitted XLA program does the
+negative-sampling/hierarchical-softmax math with scatter-add updates
+(SURVEY §7 step 8's segment-sum design).
+"""
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    NGramTokenizerFactory,
+    TokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabConstructor, VocabWord
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.paragraphvectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+
+__all__ = [
+    "AbstractCache",
+    "CommonPreprocessor",
+    "DefaultTokenizerFactory",
+    "Glove",
+    "NGramTokenizerFactory",
+    "ParagraphVectors",
+    "TokenizerFactory",
+    "VocabConstructor",
+    "VocabWord",
+    "Word2Vec",
+    "WordVectorSerializer",
+]

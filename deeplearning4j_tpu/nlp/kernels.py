@@ -1,0 +1,141 @@
+"""Jitted embedding-training kernels.
+
+Parity surface: reference ``models/embeddings/learning/impl/elements/
+SkipGram.java:156-283`` (learnSequence -> batched native sg op) and
+``CBOW.java`` — there the math lives in libnd4j's custom sg/cbow CUDA/C++
+kernels; here each step is ONE XLA program: gathers, closed-form SGNS/HS
+gradients, and scatter-adds (``.at[].add``) that XLA lowers to efficient TPU
+scatters. Duplicate indices within a batch accumulate, matching the
+sequential semantics of the reference's hogwild updates in expectation.
+
+All steps donate the embedding tables: no copies in the hot loop, HBM-bandwidth
+friendly."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-7
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def sgns_step(syn0, syn1neg, centers, contexts, negs, wmask, lr):
+    """Skip-gram negative sampling.
+
+    syn0 (V, D) input vectors; syn1neg (V, D) output vectors;
+    centers/contexts (B,) int32; negs (B, K) int32; wmask (B,) 1/0 padding
+    mask (ragged final batches pad to the compiled batch size); lr scalar.
+
+    word2vec convention (and the reference's SkipGram op): the *context*
+    word's input vector is trained against the *center* word's output path.
+    Callers pass (centers, contexts) as generated; the symmetric pairing
+    means either orientation converges identically.
+    """
+    v = syn0[contexts]                                   # (B, D)
+    u_pos = syn1neg[centers]                             # (B, D)
+    u_neg = syn1neg[negs]                                # (B, K, D)
+    s_pos = jax.nn.sigmoid(jnp.sum(v * u_pos, axis=-1))  # (B,)
+    s_neg = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", v, u_neg))
+    g_pos = (1.0 - s_pos) * wmask                        # label 1
+    g_neg = -s_neg * wmask[:, None]                      # label 0
+    dv = g_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
+    du_pos = g_pos[:, None] * v
+    du_neg = g_neg[..., None] * v[:, None, :]
+    B, K = negs.shape
+    D = v.shape[-1]
+    syn0 = syn0.at[contexts].add(lr * dv)
+    syn1neg = syn1neg.at[centers].add(lr * du_pos)
+    syn1neg = syn1neg.at[negs.reshape(-1)].add(lr * du_neg.reshape(B * K, D))
+    nll = -(jnp.log(s_pos + _EPS) + jnp.sum(jnp.log(1.0 - s_neg + _EPS), axis=-1))
+    loss = jnp.sum(nll * wmask) / jnp.maximum(jnp.sum(wmask), 1.0)
+    return syn0, syn1neg, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def hs_step(syn0, syn1, contexts, codes, points, lengths, lr):
+    """Skip-gram hierarchical softmax.
+
+    codes/points (B, L) per-pair Huffman path of the center word, lengths (B,)
+    valid path length. The ragged walk of the reference
+    (SkipGram.java inner loop over vocabWord.getPoints()) becomes a masked
+    dense (B, L, D) computation."""
+    v = syn0[contexts]                                   # (B, D)
+    u = syn1[points]                                     # (B, L, D)
+    B, L = codes.shape
+    # padding rows carry lengths=0, so the path mask doubles as batch mask
+    mask = (jnp.arange(L)[None, :] < lengths[:, None]).astype(v.dtype)
+    s = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", v, u))   # (B, L)
+    g = (1.0 - codes.astype(v.dtype) - s) * mask         # word2vec: 1 - code - sigma
+    dv = jnp.einsum("bl,bld->bd", g, u)
+    du = g[..., None] * v[:, None, :]
+    D = v.shape[-1]
+    syn0 = syn0.at[contexts].add(lr * dv)
+    syn1 = syn1.at[points.reshape(-1)].add(lr * du.reshape(B * L, D))
+    # masked binary cross-entropy along the path
+    target = 1.0 - codes.astype(v.dtype)
+    bce = -(target * jnp.log(s + _EPS) + (1.0 - target) * jnp.log(1.0 - s + _EPS))
+    loss = jnp.sum(bce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return syn0, syn1, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def cbow_step(syn0, syn1neg, centers, context_bags, bag_mask, negs, wmask, lr):
+    """CBOW with negative sampling (reference CBOW.java).
+
+    context_bags (B, W) int32 context indices (padded), bag_mask (B, W) 1/0,
+    centers (B,), negs (B, K), wmask (B,) batch padding mask. The bag mean
+    predicts the center."""
+    bags = syn0[context_bags]                             # (B, W, D)
+    m = bag_mask[..., None]
+    denom = jnp.maximum(jnp.sum(bag_mask, axis=-1, keepdims=True), 1.0)
+    h = jnp.sum(bags * m, axis=1) / denom                 # (B, D) bag mean
+    u_pos = syn1neg[centers]
+    u_neg = syn1neg[negs]
+    s_pos = jax.nn.sigmoid(jnp.sum(h * u_pos, axis=-1))
+    s_neg = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, u_neg))
+    g_pos = (1.0 - s_pos) * wmask
+    g_neg = -s_neg * wmask[:, None]
+    dh = g_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
+    du_pos = g_pos[:, None] * h
+    du_neg = g_neg[..., None] * h[:, None, :]
+    B, K = negs.shape
+    D = h.shape[-1]
+    W = context_bags.shape[1]
+    # distribute the bag gradient equally to members (mean => /count)
+    dbag = (dh[:, None, :] * m) / denom[..., None]        # (B, W, D)
+    syn0 = syn0.at[context_bags.reshape(-1)].add(lr * dbag.reshape(B * W, D))
+    syn1neg = syn1neg.at[centers].add(lr * du_pos)
+    syn1neg = syn1neg.at[negs.reshape(-1)].add(lr * du_neg.reshape(B * K, D))
+    nll = -(jnp.log(s_pos + _EPS) + jnp.sum(jnp.log(1.0 - s_neg + _EPS), axis=-1))
+    loss = jnp.sum(nll * wmask) / jnp.maximum(jnp.sum(wmask), 1.0)
+    return syn0, syn1neg, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def glove_step(w, wc, b, bc, gw, gwc, gb, gbc, rows, cols, logx, weight, lr):
+    """AdaGrad step on the GloVe objective (reference glove/Glove.java +
+    legacy GloVe.java AdaGrad math): f(x) * (w_i·wc_j + b_i + bc_j - log x)^2.
+
+    w/wc (V, D) main/context vectors, b/bc (V,) biases, g* AdaGrad
+    accumulators, rows/cols (B,) co-occurrence pair indices, logx (B,)
+    log co-occurrence, weight (B,) f(x)."""
+    wi = w[rows]
+    wj = wc[cols]
+    diff = jnp.sum(wi * wj, axis=-1) + b[rows] + bc[cols] - logx   # (B,)
+    fdiff = weight * diff
+    loss = 0.5 * jnp.mean(fdiff * diff)
+    dwi = fdiff[:, None] * wj
+    dwj = fdiff[:, None] * wi
+    # AdaGrad: accumulate squared grads, scale updates
+    gw = gw.at[rows].add(dwi * dwi)
+    gwc = gwc.at[cols].add(dwj * dwj)
+    gb = gb.at[rows].add(fdiff * fdiff)
+    gbc = gbc.at[cols].add(fdiff * fdiff)
+    w = w.at[rows].add(-lr * dwi / jnp.sqrt(gw[rows] + _EPS))
+    wc = wc.at[cols].add(-lr * dwj / jnp.sqrt(gwc[cols] + _EPS))
+    b = b.at[rows].add(-lr * fdiff / jnp.sqrt(gb[rows] + _EPS))
+    bc = bc.at[cols].add(-lr * fdiff / jnp.sqrt(gbc[cols] + _EPS))
+    return w, wc, b, bc, gw, gwc, gb, gbc, loss

@@ -1,0 +1,290 @@
+"""SequenceVectors — the generic embedding trainer.
+
+Parity surface: reference ``models/sequencevectors/SequenceVectors.java:49``
+(:136 vocab build, :192 fit spawning VectorCalculationsThreads) with learning
+algorithms ``SkipGram.java:156`` / ``CBOW.java``.
+
+TPU-native redesign: the reference's producer/consumer threads + native sg
+kernel become (a) a vectorized numpy pass that turns a chunk of index
+sequences into dense (center, context) pair batches — subsampling, dynamic
+window shrink, negative sampling all vectorized — and (b) one jitted scatter
+step per batch (kernels.py). Sequences are anything that yields token lists,
+so DeepWalk graph walks and ParagraphVectors documents reuse this class
+unchanged (mirroring the reference's SequenceVectors genericity)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp import kernels
+from deeplearning4j_tpu.nlp.vocab import (
+    AbstractCache, VocabConstructor, build_huffman, unigram_table,
+)
+
+log = logging.getLogger(__name__)
+
+
+class SequenceVectors:
+    """Train element embeddings over sequences (see module docstring).
+
+    Builder-style keyword config mirrors the reference's
+    SequenceVectors.Builder: layer_size, window_size, negative (0 => use
+    hierarchical softmax), learning_rate/min_learning_rate (linear decay),
+    sampling (subsampling threshold), epochs, batch_size, min_word_frequency,
+    use_cbow."""
+
+    def __init__(self, layer_size: int = 100, window_size: int = 5,
+                 negative: int = 5, use_hierarchic_softmax: Optional[bool] = None,
+                 learning_rate: float = 0.025, min_learning_rate: float = 1e-4,
+                 sampling: float = 0.0, epochs: int = 1, iterations: int = 1,
+                 batch_size: int = 2048, min_word_frequency: int = 1,
+                 use_cbow: bool = False, seed: int = 12345):
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.negative = negative
+        self.use_hs = (negative == 0 if use_hierarchic_softmax is None
+                       else use_hierarchic_softmax)
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.sampling = sampling
+        self.epochs = epochs
+        self.iterations = iterations
+        self.batch_size = batch_size
+        self.min_word_frequency = min_word_frequency
+        self.use_cbow = use_cbow
+        self.seed = seed
+
+        self.vocab: Optional[AbstractCache] = None
+        self.syn0: Optional[np.ndarray] = None
+        self.syn1: Optional[np.ndarray] = None
+        self._codes = self._points = self._lengths = None
+        self._neg_table: Optional[np.ndarray] = None
+        self._rng = np.random.default_rng(seed)
+        self.words_processed = 0
+
+    # ------------------------------------------------------------ vocab/init
+    def build_vocab(self, sequences: Iterable[List[str]]):
+        self.vocab = VocabConstructor(self.min_word_frequency) \
+            .build_joint_vocabulary([sequences])
+        return self
+
+    def _init_tables(self):
+        v, d = self.vocab.num_words(), self.layer_size
+        self.syn0 = ((self._rng.random((v, d), np.float32) - 0.5) / d)
+        self.syn1 = np.zeros((v, d), np.float32)
+        if self.use_hs:
+            self._codes, self._points, self._lengths = build_huffman(self.vocab)
+        if self.negative > 0:
+            self._neg_table = unigram_table(self.vocab)
+
+    # --------------------------------------------------------- vectorization
+    def _index_sequences(self, sequences: Iterable[List[str]]):
+        """tokens -> index arrays, dropping OOV words (reference: vocab-filtered
+        sequences in SequenceVectors' AsyncSequencer)."""
+        widx = {vw.word: vw.index for vw in self.vocab.vocab_words()}
+        for tokens in sequences:
+            idx = [widx[t] for t in tokens if t in widx]
+            if len(idx) >= 2:
+                yield np.asarray(idx, np.int64)
+
+    def _subsample(self, flat, sid):
+        """Frequent-word subsampling (word2vec formula; reference
+        SkipGram's sequence pre-filter with ``sampling > 0``)."""
+        if not self.sampling:
+            return flat, sid
+        counts = np.array([vw.count for vw in self.vocab.vocab_words()], np.float64)
+        total = counts.sum()
+        freq = counts / total
+        t = self.sampling
+        keep_prob = np.minimum(1.0, np.sqrt(t / freq) + t / freq)
+        keep = self._rng.random(len(flat)) < keep_prob[flat]
+        return flat[keep], sid[keep]
+
+    def _pairs_for_chunk(self, seqs: List[np.ndarray]):
+        """Vectorized window pair generation over a chunk of sequences.
+        Returns (centers, contexts) with the reference's dynamic window:
+        per-center radius uniform in [1, window]."""
+        flat = np.concatenate(seqs)
+        sid = np.repeat(np.arange(len(seqs)), [len(s) for s in seqs])
+        flat, sid = self._subsample(flat, sid)
+        n = len(flat)
+        if n < 2:
+            return (np.zeros(0, np.int64),) * 2
+        r = self._rng.integers(1, self.window_size + 1, n)
+        centers, contexts = [], []
+        for d in range(1, self.window_size + 1):
+            same = sid[:-d] == sid[d:]
+            left = same & (d <= r[:-d])    # center i, context i+d
+            right = same & (d <= r[d:])    # center i+d, context i
+            centers.append(flat[:-d][left])
+            contexts.append(flat[d:][left])
+            centers.append(flat[d:][right])
+            contexts.append(flat[:-d][right])
+        return np.concatenate(centers), np.concatenate(contexts)
+
+    def _bags_for_chunk(self, seqs: List[np.ndarray]):
+        """CBOW bags: for each center, its (2*window) padded context bag."""
+        flat = np.concatenate(seqs)
+        sid = np.repeat(np.arange(len(seqs)), [len(s) for s in seqs])
+        flat, sid = self._subsample(flat, sid)
+        n = len(flat)
+        w = self.window_size
+        if n < 2:
+            return (np.zeros(0, np.int64), np.zeros((0, 2 * w), np.int64),
+                    np.zeros((0, 2 * w), np.float32))
+        r = self._rng.integers(1, w + 1, n)
+        bags = np.zeros((n, 2 * w), np.int64)
+        mask = np.zeros((n, 2 * w), np.float32)
+        col = 0
+        for d in range(1, w + 1):
+            for sign in (-1, 1):
+                src = np.arange(n) + sign * d
+                ok = (src >= 0) & (src < n)
+                ok[ok] &= sid[src[ok]] == sid[ok.nonzero()[0]]
+                ok &= d <= r
+                bags[ok, col] = flat[src[ok]]
+                mask[ok, col] = 1.0
+                col += 1
+        has_ctx = mask.sum(-1) > 0
+        return flat[has_ctx], bags[has_ctx], mask[has_ctx]
+
+    # -------------------------------------------------------------- training
+    def _lr(self, total_expected: int) -> float:
+        frac = min(1.0, self.words_processed / max(1, total_expected))
+        return max(self.min_learning_rate, self.learning_rate * (1.0 - frac))
+
+    def _pad(self, arr, b, fill=0):
+        if len(arr) == b:
+            return arr, None
+        pad = b - len(arr)
+        wmask = np.ones(b, np.float32)
+        wmask[len(arr):] = 0.0
+        widths = ((0, pad),) + ((0, 0),) * (arr.ndim - 1)
+        return np.pad(arr, widths, constant_values=fill), wmask
+
+    def _train_pairs(self, centers, contexts, lr):
+        """Feed (center, context) pairs through the jitted steps in
+        batch_size slices; the final ragged slice pads with a zero mask."""
+        b = self.batch_size
+        loss = 0.0
+        nb = 0
+        for s in range(0, len(centers), b):
+            ce, ct = centers[s:s + b], contexts[s:s + b]
+            ce, wmask = self._pad(ce, b)
+            ct, _ = self._pad(ct, b)
+            if wmask is None:
+                wmask = np.ones(b, np.float32)
+            if self.negative > 0:
+                negs = self._neg_table[
+                    self._rng.integers(0, len(self._neg_table),
+                                       (b, self.negative))].astype(np.int32)
+                self.syn0, self.syn1, l = kernels.sgns_step(
+                    self.syn0, self.syn1, ce.astype(np.int32),
+                    ct.astype(np.int32), negs, wmask, np.float32(lr))
+            else:
+                codes = self._codes[ce]
+                points = self._points[ce]
+                lengths = (self._lengths[ce] * wmask).astype(np.int32)
+                self.syn0, self.syn1, l = kernels.hs_step(
+                    self.syn0, self.syn1, ct.astype(np.int32), codes, points,
+                    lengths, np.float32(lr))
+            loss += float(l)
+            nb += 1
+        return loss / max(nb, 1)
+
+    def _train_bags(self, centers, bags, bmask, lr):
+        b = self.batch_size
+        loss, nb = 0.0, 0
+        for s in range(0, len(centers), b):
+            ce, wmask = self._pad(centers[s:s + b], b)
+            bg, _ = self._pad(bags[s:s + b], b)
+            bm, _ = self._pad(bmask[s:s + b], b)
+            if wmask is None:
+                wmask = np.ones(b, np.float32)
+            negs = self._neg_table[
+                self._rng.integers(0, len(self._neg_table),
+                                   (b, max(1, self.negative)))].astype(np.int32)
+            self.syn0, self.syn1, l = kernels.cbow_step(
+                self.syn0, self.syn1, ce.astype(np.int32), bg.astype(np.int32),
+                bm.astype(np.float32), negs, wmask, np.float32(lr))
+            loss += float(l)
+            nb += 1
+        return loss / max(nb, 1)
+
+    def fit(self, sequences, chunk_sentences: int = 512):
+        """Train (reference SequenceVectors.fit :192). ``sequences`` is a
+        factory (callable or re-iterable) of token-list iterables."""
+        seq_factory = sequences if callable(sequences) else (lambda: sequences)
+        if self.vocab is None:
+            self.build_vocab(seq_factory())
+        if self.syn0 is None:
+            self._init_tables()
+        total = self.vocab.total_word_occurrences * self.epochs * self.iterations
+        for epoch in range(self.epochs):
+            chunk: List[np.ndarray] = []
+            for idx in self._index_sequences(seq_factory()):
+                chunk.append(idx)
+                if len(chunk) >= chunk_sentences:
+                    self._fit_chunk(chunk, total)
+                    chunk = []
+            if chunk:
+                self._fit_chunk(chunk, total)
+        return self
+
+    def _fit_chunk(self, chunk, total_expected):
+        for _ in range(self.iterations):
+            lr = self._lr(total_expected)
+            if self.use_cbow:
+                centers, bags, bmask = self._bags_for_chunk(chunk)
+                if len(centers):
+                    self._train_bags(centers, bags, bmask, lr)
+            else:
+                centers, contexts = self._pairs_for_chunk(chunk)
+                if len(centers):
+                    self._train_pairs(centers, contexts, lr)
+            self.words_processed += sum(len(s) for s in chunk)
+
+    # -------------------------------------------------------------- lookups
+    def word_vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.index_of(word)
+        return None if i < 0 else np.asarray(self.syn0[i])
+
+    def get_word_vector_matrix(self) -> np.ndarray:
+        return np.asarray(self.syn0)
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab is not None and self.vocab.contains_word(word)
+
+    def similarity(self, a: str, b: str) -> float:
+        """Cosine similarity (reference WordVectors.similarity)."""
+        va, vb = self.word_vector(a), self.word_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        denom = (np.linalg.norm(va) * np.linalg.norm(vb)) or 1e-12
+        return float(va @ vb / denom)
+
+    def words_nearest(self, word_or_vec, top_n: int = 10) -> List[str]:
+        """Nearest words by cosine (reference wordsNearest)."""
+        if isinstance(word_or_vec, str):
+            v = self.word_vector(word_or_vec)
+            exclude = {word_or_vec}
+        else:
+            v = np.asarray(word_or_vec, np.float32)
+            exclude = set()
+        if v is None:
+            return []
+        m = np.asarray(self.syn0)
+        norms = np.linalg.norm(m, axis=1) * (np.linalg.norm(v) or 1e-12)
+        sims = (m @ v) / np.maximum(norms, 1e-12)
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.word_at_index(int(i))
+            if w not in exclude:
+                out.append(w)
+            if len(out) >= top_n:
+                break
+        return out
