@@ -1,71 +1,197 @@
-"""Benchmark: LeNet MNIST training throughput on one TPU chip.
+"""Benchmarks for the BASELINE configs, on one TPU chip.
 
-BASELINE configs[0] ("LeNet MultiLayerNetwork on MNIST, single chip"). The
-reference repo publishes no numbers (BASELINE.md); ``vs_baseline`` is
-reported against a nominal V100 nd4j-cuda LeNet throughput estimate so the
-ratio is meaningful across rounds.
+Covers BASELINE.json configs[0]-[3]:
+  0. LeNet MultiLayerNetwork on MNIST            -> imgs/sec
+  1. ResNet50 ComputationGraph (north star)      -> imgs/sec (+ MFU estimate)
+  2. GravesLSTM char-RNN (tBPTT windows)         -> chars/sec
+  3. Word2Vec skip-gram negative sampling        -> words/sec
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference repo publishes no numbers (BASELINE.md); each ``vs_baseline``
+is reported against a fixed nominal V100-era denominator so the ratio is
+meaningful across rounds.
+
+Prints ONE JSON line per benchmark; the north-star ResNet50 line prints
+last. Set BENCH_QUICK=1 for a tiny smoke run (CI / CPU).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
 
-# The reference publishes no LeNet numbers; this is the driver-era nominal
-# V100 figure used as the fixed denominator across rounds.
-NOMINAL_V100_LENET_IMGS_PER_SEC = 10_000.0
+QUICK = os.environ.get("BENCH_QUICK") == "1"
 
-BATCH = 256
-WARMUP_STEPS = 10
-MEASURE_STEPS = 300
+# Nominal V100-era denominators (the reference publishes nothing; these are
+# order-of-magnitude figures for the CUDA stacks of that generation).
+NOMINAL = {
+    "lenet": 10_000.0,      # imgs/sec, LeNet MNIST
+    "resnet50": 360.0,      # imgs/sec, fp32 V100 ResNet50 ImageNet
+    "charlstm": 100_000.0,  # chars/sec, cuDNN LSTM char-RNN
+    "word2vec": 500_000.0,  # words/sec, multithreaded host SGNS
+}
 
 
-def main():
+def emit(metric, value, unit, baseline_key, **extra):
+    line = {"metric": metric, "value": round(value, 1), "unit": unit,
+            "vs_baseline": round(value / NOMINAL[baseline_key], 3)}
+    line.update(extra)
+    print(json.dumps(line), flush=True)
+
+
+def bench_lenet():
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.datasets.fetchers import synthetic_mnist
     from deeplearning4j_tpu.models import LeNet
 
+    batch = 64 if QUICK else 256
+    warmup, steps = (2, 5) if QUICK else (10, 300)
     net = LeNet(num_classes=10).init()
-    x_np, y_np = synthetic_mnist(BATCH * 4, seed=7)
+    x_np, y_np = synthetic_mnist(batch * 4, seed=7)
     step = net._get_jitted("train")
-
-    batches = []
-    for i in range(4):
-        sl = slice(i * BATCH, (i + 1) * BATCH)
-        batches.append((jnp.asarray(x_np[sl]), jnp.asarray(y_np[sl])))
+    batches = [(jnp.asarray(x_np[i * batch:(i + 1) * batch]),
+                jnp.asarray(y_np[i * batch:(i + 1) * batch])) for i in range(4)]
 
     def run_one(i):
-        x, y = batches[i % len(batches)]
+        x, y = batches[i % 4]
         net._rng, k = jax.random.split(net._rng)
-        net.params, net.state, net.opt_state, loss = step(
+        net.params, net.state, net.opt_state, _ = step(
             net.params, net.state, net.opt_state, k, x, y, None, None)
-        return loss
 
-    for i in range(WARMUP_STEPS):
+    for i in range(warmup):
         run_one(i)
     jax.block_until_ready(net.params)
-
     # steps pipeline asynchronously; blocking on the params chain at the end
     # measures sustained device throughput (per-step host sync would measure
     # tunnel round-trip latency instead)
     t0 = time.perf_counter()
-    for i in range(MEASURE_STEPS):
+    for i in range(steps):
         run_one(i)
     jax.block_until_ready(net.params)
     dt = time.perf_counter() - t0
+    emit("lenet_mnist_train_imgs_per_sec_per_chip", steps * batch / dt,
+         "imgs/sec", "lenet")
 
-    imgs_per_sec = MEASURE_STEPS * BATCH / dt
-    print(json.dumps({
-        "metric": "lenet_mnist_train_imgs_per_sec_per_chip",
-        "value": round(imgs_per_sec, 1),
-        "unit": "imgs/sec",
-        "vs_baseline": round(imgs_per_sec / NOMINAL_V100_LENET_IMGS_PER_SEC, 3),
-    }))
+
+def bench_resnet50():
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models import ResNet50
+
+    if QUICK:
+        batch, side, warmup, steps = 2, 64, 1, 2
+    else:
+        batch = int(os.environ.get("BENCH_RESNET_BATCH", "128"))
+        side, warmup, steps = 224, 3, 20
+    net = ResNet50(num_classes=1000, input_shape=(side, side, 3)).init()
+    step = net._get_jitted("train")
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, side, side, 3), np.float32))
+    y = jnp.asarray(np.eye(1000, dtype=np.float32)[
+        rng.integers(0, 1000, batch)])
+
+    def run_one():
+        net._rng, k = jax.random.split(net._rng)
+        net.params, net.state, net.opt_state, _ = step(
+            net.params, net.state, net.opt_state, k, [x], [y], None, None)
+
+    for _ in range(warmup):
+        run_one()
+    jax.block_until_ready(net.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        run_one()
+    jax.block_until_ready(net.params)
+    dt = time.perf_counter() - t0
+    imgs_per_sec = steps * batch / dt
+    # ~4.1 GFLOPs fwd per 224x224 image (mult-add = 2 flops); training ~ 3x
+    # fwd. MFU denominator is configurable (chip generations differ); the
+    # default 197e12 is v5e bf16 peak.
+    train_flops_per_img = 3 * 4.1e9 * (side / 224) ** 2
+    achieved = imgs_per_sec * train_flops_per_img
+    peak = float(os.environ.get("BENCH_PEAK_FLOPS", "197e12"))
+    emit("resnet50_imagenet_train_imgs_per_sec_per_chip", imgs_per_sec,
+         "imgs/sec", "resnet50", batch=batch,
+         achieved_tflops=round(achieved / 1e12, 2),
+         mfu=round(achieved / peak, 4))
+
+
+def bench_graveslstm():
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models import TextGenerationLSTM
+
+    vocab = 47
+    if QUICK:
+        batch, T, warmup, steps = 8, 16, 1, 3
+    else:
+        batch, T, warmup, steps = 64, 50, 5, 60
+    net = TextGenerationLSTM(total_unique_characters=vocab,
+                             tbptt_length=T).init()
+    step = net._get_jitted("tbptt")
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, (batch, T))
+    x = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids])
+    y = jnp.asarray(np.eye(vocab, dtype=np.float32)[
+        rng.integers(0, vocab, (batch, T))])
+    carries = net._zero_carries(batch)
+
+    def run_one(carries):
+        net._rng, k = jax.random.split(net._rng)
+        net.params, net.state, net.opt_state, carries, _ = step(
+            net.params, net.state, net.opt_state, carries, k, x, y, None, None)
+        return carries
+
+    for _ in range(warmup):
+        carries = run_one(carries)
+    jax.block_until_ready(net.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        carries = run_one(carries)
+    jax.block_until_ready(net.params)
+    dt = time.perf_counter() - t0
+    emit("graveslstm_charrnn_train_chars_per_sec_per_chip",
+         steps * batch * T / dt, "chars/sec", "charlstm")
+
+
+def bench_word2vec():
+    from deeplearning4j_tpu.nlp import Word2Vec
+
+    rng = np.random.default_rng(0)
+    if QUICK:
+        n_sent, sent_len, vocab_n, batch = 200, 10, 500, 1024
+    else:
+        n_sent, sent_len, vocab_n, batch = 5000, 20, 10_000, 32_768
+    # zipf-ish unigram distribution over a synthetic vocab
+    ranks = np.arange(1, vocab_n + 1, dtype=np.float64)
+    probs = (1.0 / ranks) / np.sum(1.0 / ranks)
+    words = np.array([f"w{i}" for i in range(vocab_n)])
+    sents = [" ".join(words[rng.choice(vocab_n, sent_len, p=probs)])
+             for i in range(n_sent)]
+    model = Word2Vec(layer_size=128, window_size=5, negative=5, epochs=1,
+                     batch_size=batch, min_word_frequency=1, seed=1)
+    chunk = max(512, n_sent)               # one big chunk: fewer dispatches
+    model.fit(sents, chunk_sentences=chunk)    # vocab + compile + warmup
+    total_words = model.vocab.total_word_occurrences
+    t0 = time.perf_counter()
+    model.fit(sents, chunk_sentences=chunk)
+    dt = time.perf_counter() - t0
+    emit("word2vec_sgns_train_words_per_sec_per_chip", total_words / dt,
+         "words/sec", "word2vec")
+
+
+def main():
+    benches = [("lenet", bench_lenet), ("word2vec", bench_word2vec),
+               ("charlstm", bench_graveslstm), ("resnet50", bench_resnet50)]
+    for name, fn in benches:
+        try:
+            fn()
+        except Exception as e:  # keep the remaining benches alive
+            print(json.dumps({"metric": name, "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
 
 
 if __name__ == "__main__":

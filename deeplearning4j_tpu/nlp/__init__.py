@@ -15,18 +15,35 @@ from deeplearning4j_tpu.nlp.tokenization import (
     TokenizerFactory,
 )
 from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabConstructor, VocabWord
+from deeplearning4j_tpu.nlp.sentenceiterator import (
+    BasicLineIterator,
+    CollectionSentenceIterator,
+    LabelAwareIterator,
+    LabelledDocument,
+    SentenceIterator,
+    SimpleLabelAwareIterator,
+)
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 from deeplearning4j_tpu.nlp.paragraphvectors import ParagraphVectors
 from deeplearning4j_tpu.nlp.glove import Glove
-from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+from deeplearning4j_tpu.nlp.serializer import StaticWordVectors, WordVectorSerializer
 
 __all__ = [
     "AbstractCache",
+    "BasicLineIterator",
+    "CollectionSentenceIterator",
     "CommonPreprocessor",
     "DefaultTokenizerFactory",
     "Glove",
+    "LabelAwareIterator",
+    "LabelledDocument",
     "NGramTokenizerFactory",
     "ParagraphVectors",
+    "SentenceIterator",
+    "SequenceVectors",
+    "SimpleLabelAwareIterator",
+    "StaticWordVectors",
     "TokenizerFactory",
     "VocabConstructor",
     "VocabWord",

@@ -9,7 +9,17 @@ scatters. Duplicate indices within a batch accumulate, matching the
 sequential semantics of the reference's hogwild updates in expectation.
 
 All steps donate the embedding tables: no copies in the hot loop, HBM-bandwidth
-friendly."""
+friendly.
+
+Stability note: the reference applies pair updates *sequentially* (hogwild
+host threads), so each touch of a row moves it by at most ~lr. A naive
+batched scatter-ADD instead sums the gradients of every duplicate index in
+the batch — with a small vocab (or very frequent words) that multiplies the
+effective step by the duplicate count and diverges. The TPU-native answer
+here is a count-normalized scatter (scatter-mean per destination row): each
+row moves by lr times the *average* gradient of the pairs touching it, which
+matches the sequential semantics in expectation and is unconditionally
+stable."""
 
 from __future__ import annotations
 
@@ -19,6 +29,18 @@ import jax
 import jax.numpy as jnp
 
 _EPS = 1e-7
+
+
+def _scatter_mean_update(table, idx, grads, weights, lr):
+    """table += lr * segment_mean(grads over idx).
+
+    idx (N,) int32 destination rows, grads (N, D), weights (N,) 0/1 validity.
+    Rows untouched in this batch keep count 0 and receive no update. Cost is
+    O(N*D) — only a (V,) count vector is materialized, never a (V, D)
+    accumulator, so the per-batch work stays proportional to the batch."""
+    cnt = jnp.zeros((table.shape[0],), table.dtype).at[idx].add(weights)
+    scale = (weights / jnp.maximum(cnt, 1.0)[idx])[:, None]
+    return table.at[idx].add(lr * grads * scale)
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -46,9 +68,12 @@ def sgns_step(syn0, syn1neg, centers, contexts, negs, wmask, lr):
     du_neg = g_neg[..., None] * v[:, None, :]
     B, K = negs.shape
     D = v.shape[-1]
-    syn0 = syn0.at[contexts].add(lr * dv)
-    syn1neg = syn1neg.at[centers].add(lr * du_pos)
-    syn1neg = syn1neg.at[negs.reshape(-1)].add(lr * du_neg.reshape(B * K, D))
+    syn0 = _scatter_mean_update(syn0, contexts, dv, wmask, lr)
+    # centers and negatives both land in syn1neg: one joint normalized scatter
+    out_idx = jnp.concatenate([centers, negs.reshape(-1)])
+    out_grads = jnp.concatenate([du_pos, du_neg.reshape(B * K, D)])
+    out_w = jnp.concatenate([wmask, jnp.repeat(wmask, K)])
+    syn1neg = _scatter_mean_update(syn1neg, out_idx, out_grads, out_w, lr)
     nll = -(jnp.log(s_pos + _EPS) + jnp.sum(jnp.log(1.0 - s_neg + _EPS), axis=-1))
     loss = jnp.sum(nll * wmask) / jnp.maximum(jnp.sum(wmask), 1.0)
     return syn0, syn1neg, loss
@@ -72,8 +97,10 @@ def hs_step(syn0, syn1, contexts, codes, points, lengths, lr):
     dv = jnp.einsum("bl,bld->bd", g, u)
     du = g[..., None] * v[:, None, :]
     D = v.shape[-1]
-    syn0 = syn0.at[contexts].add(lr * dv)
-    syn1 = syn1.at[points.reshape(-1)].add(lr * du.reshape(B * L, D))
+    valid = (lengths > 0).astype(v.dtype)
+    syn0 = _scatter_mean_update(syn0, contexts, dv, valid, lr)
+    syn1 = _scatter_mean_update(syn1, points.reshape(-1),
+                                du.reshape(B * L, D), mask.reshape(-1), lr)
     # masked binary cross-entropy along the path
     target = 1.0 - codes.astype(v.dtype)
     bce = -(target * jnp.log(s + _EPS) + (1.0 - target) * jnp.log(1.0 - s + _EPS))
@@ -106,12 +133,94 @@ def cbow_step(syn0, syn1neg, centers, context_bags, bag_mask, negs, wmask, lr):
     W = context_bags.shape[1]
     # distribute the bag gradient equally to members (mean => /count)
     dbag = (dh[:, None, :] * m) / denom[..., None]        # (B, W, D)
-    syn0 = syn0.at[context_bags.reshape(-1)].add(lr * dbag.reshape(B * W, D))
-    syn1neg = syn1neg.at[centers].add(lr * du_pos)
-    syn1neg = syn1neg.at[negs.reshape(-1)].add(lr * du_neg.reshape(B * K, D))
+    bag_w = (bag_mask * wmask[:, None]).reshape(-1)
+    syn0 = _scatter_mean_update(syn0, context_bags.reshape(-1),
+                                dbag.reshape(B * W, D), bag_w, lr)
+    out_idx = jnp.concatenate([centers, negs.reshape(-1)])
+    out_grads = jnp.concatenate([du_pos, du_neg.reshape(B * K, D)])
+    out_w = jnp.concatenate([wmask, jnp.repeat(wmask, K)])
+    syn1neg = _scatter_mean_update(syn1neg, out_idx, out_grads, out_w, lr)
     nll = -(jnp.log(s_pos + _EPS) + jnp.sum(jnp.log(1.0 - s_neg + _EPS), axis=-1))
     loss = jnp.sum(nll * wmask) / jnp.maximum(jnp.sum(wmask), 1.0)
     return syn0, syn1neg, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def cbow_hs_step(syn0, syn1, centers_codes, centers_points, centers_lengths,
+                 context_bags, bag_mask, lr):
+    """CBOW with hierarchical softmax (reference CBOW.java's HS branch):
+    the context-bag mean walks the *center* word's Huffman path.
+
+    centers_codes/points (B, L), centers_lengths (B,) — padded batch rows
+    carry lengths=0 so the path mask doubles as the batch mask (as in
+    hs_step). context_bags (B, W) int32, bag_mask (B, W)."""
+    bags = syn0[context_bags]                             # (B, W, D)
+    m = bag_mask[..., None]
+    denom = jnp.maximum(jnp.sum(bag_mask, axis=-1, keepdims=True), 1.0)
+    h = jnp.sum(bags * m, axis=1) / denom                 # (B, D)
+    u = syn1[centers_points]                              # (B, L, D)
+    B, L = centers_codes.shape
+    mask = (jnp.arange(L)[None, :] < centers_lengths[:, None]).astype(h.dtype)
+    s = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", h, u))
+    g = (1.0 - centers_codes.astype(h.dtype) - s) * mask
+    dh = jnp.einsum("bl,bld->bd", g, u)
+    du = g[..., None] * h[:, None, :]
+    D = h.shape[-1]
+    W = context_bags.shape[1]
+    dbag = (dh[:, None, :] * m) / denom[..., None]
+    valid = (centers_lengths > 0).astype(h.dtype)
+    bag_w = (bag_mask * valid[:, None]).reshape(-1)
+    syn0 = _scatter_mean_update(syn0, context_bags.reshape(-1),
+                                dbag.reshape(B * W, D), bag_w, lr)
+    syn1 = _scatter_mean_update(syn1, centers_points.reshape(-1),
+                                du.reshape(B * L, D), mask.reshape(-1), lr)
+    target = 1.0 - centers_codes.astype(h.dtype)
+    bce = -(target * jnp.log(s + _EPS) + (1.0 - target) * jnp.log(1.0 - s + _EPS))
+    loss = jnp.sum(bce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return syn0, syn1, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def sgns_infer_step(docvec, syn1neg, centers, negs, wmask, lr):
+    """DBOW inference step (reference ParagraphVectors.inferVector): a single
+    frozen-everything-else SGNS pass where only the document vector trains.
+
+    docvec (D,); centers (B,) words of the document; negs (B, K)."""
+    u_pos = syn1neg[centers]                              # (B, D)
+    u_neg = syn1neg[negs]                                 # (B, K, D)
+    s_pos = jax.nn.sigmoid(u_pos @ docvec)                # (B,)
+    s_neg = jax.nn.sigmoid(jnp.einsum("bkd,d->bk", u_neg, docvec))
+    g_pos = (1.0 - s_pos) * wmask
+    g_neg = -s_neg * wmask[:, None]
+    dv = jnp.einsum("b,bd->d", g_pos, u_pos) + \
+        jnp.einsum("bk,bkd->d", g_neg, u_neg)
+    docvec = docvec + lr * dv / jnp.maximum(jnp.sum(wmask), 1.0)
+    nll = -(jnp.log(s_pos + _EPS) + jnp.sum(jnp.log(1.0 - s_neg + _EPS), axis=-1))
+    loss = jnp.sum(nll * wmask) / jnp.maximum(jnp.sum(wmask), 1.0)
+    return docvec, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def cbow_infer_step(docvec, syn0, syn1neg, centers, context_bags, bag_mask,
+                    negs, wmask, lr):
+    """DM inference step: the doc vector joins each context bag (frozen word
+    vectors), gradient flows to the doc vector only."""
+    bags = syn0[context_bags]                             # (B, W, D)
+    m = bag_mask[..., None]
+    count = jnp.sum(bag_mask, axis=-1, keepdims=True) + 1.0   # + doc vector
+    h = (jnp.sum(bags * m, axis=1) + docvec[None, :]) / count
+    u_pos = syn1neg[centers]
+    u_neg = syn1neg[negs]
+    s_pos = jax.nn.sigmoid(jnp.sum(h * u_pos, axis=-1))
+    s_neg = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, u_neg))
+    g_pos = (1.0 - s_pos) * wmask
+    g_neg = -s_neg * wmask[:, None]
+    dh = g_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
+    dv = jnp.sum(dh / count, axis=0)                      # doc's share of each bag
+    docvec = docvec + lr * dv / jnp.maximum(jnp.sum(wmask), 1.0)
+    nll = -(jnp.log(s_pos + _EPS) + jnp.sum(jnp.log(1.0 - s_neg + _EPS), axis=-1))
+    loss = jnp.sum(nll * wmask) / jnp.maximum(jnp.sum(wmask), 1.0)
+    return docvec, loss
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))

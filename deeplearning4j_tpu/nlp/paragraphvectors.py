@@ -1,0 +1,241 @@
+"""ParagraphVectors (doc2vec).
+
+Parity surface: reference ``models/paragraphvectors/ParagraphVectors.java:60``
+(1,461 LoC: Builder wiring LabelAwareIterator + LabelsSource, fit, and
+inferVector) with sequence learning algorithms
+``models/embeddings/learning/impl/sequence/DBOW.java`` (the doc vector
+predicts each word, PV-DBOW) and ``DM.java`` (the doc vector joins every
+context bag, PV-DM).
+
+TPU redesign: document vectors live as extra rows appended after the V word
+rows of the shared ``syn0`` table, so the existing jitted SGNS/CBOW/HS scatter
+kernels train words and documents in the same XLA program — DBOW is
+``sgns_step`` with the document row as the input-side index, DM is
+``cbow_step`` with the document row appended to each context bag.
+``infer_vector`` runs the frozen-tables kernels (kernels.sgns_infer_step /
+cbow_infer_step) so inference never mutates the model, matching the
+reference's locked-learning inferVector semantics."""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp import kernels
+from deeplearning4j_tpu.nlp.sentenceiterator import (
+    LabelAwareIterator, LabelAwareListSentenceIterator, LabelledDocument,
+    SimpleLabelAwareIterator,
+)
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+Docs = Union[LabelAwareIterator, Sequence[LabelledDocument], Sequence[str]]
+
+
+class ParagraphVectors(Word2Vec):
+    """PV-DM / PV-DBOW document embeddings.
+
+    ``dm=True`` selects PV-DM (reference ``new DM<>()``), ``dm=False``
+    PV-DBOW (``new DBOW<>()``). ``train_words`` additionally runs plain
+    skip-gram over the words (reference ``trainWordVectors(true)``)."""
+
+    def __init__(self, dm: bool = True, train_words: bool = False, **kwargs):
+        super().__init__(**kwargs)
+        self.dm = dm
+        self.train_words = train_words
+        self.label_index: Dict[str, int] = {}
+
+    # ------------------------------------------------------------ documents
+    def _as_docs(self, documents: Docs) -> LabelAwareIterator:
+        if isinstance(documents, LabelAwareIterator):
+            return documents
+        documents = list(documents)
+        if documents and isinstance(documents[0], LabelledDocument):
+            return SimpleLabelAwareIterator(documents)
+        return LabelAwareListSentenceIterator(list(documents))
+
+    def _doc_tokens(self, doc: LabelledDocument) -> List[str]:
+        return self.tokenizer_factory.create(doc.content).get_tokens()
+
+    # -------------------------------------------------------------- training
+    def fit(self, documents: Docs, chunk_docs: int = 256):
+        it = self._as_docs(documents)
+        if self.vocab is None:
+            it.reset()
+            self.build_vocab(self._doc_tokens(d) for d in it)
+        # collect labels in first-seen order (reference LabelsSource)
+        it.reset()
+        for d in it:
+            for lbl in d.labels:
+                self.label_index.setdefault(lbl, len(self.label_index))
+        if self.syn0 is None:
+            self._init_tables()
+        # append one doc row per label after the V word rows; refits with
+        # fresh labels grow the table so new rows are trained, not silently
+        # scatter-dropped out of bounds
+        want = self.vocab.num_words() + len(self.label_index)
+        have = self.syn0.shape[0]
+        if have < want:
+            D = self.syn0.shape[1]
+            doc_rows = ((self._rng.random((want - have, D), np.float32) - 0.5) / D)
+            self.syn0 = np.concatenate([np.asarray(self.syn0), doc_rows])
+        widx = {vw.word: vw.index for vw in self.vocab.vocab_words()}
+        V = self.vocab.num_words()
+        total = self.vocab.total_word_occurrences * self.epochs * self.iterations
+        for _ in range(self.epochs):
+            chunk: List[Tuple[np.ndarray, int]] = []
+            it.reset()
+            for d in it:
+                idx = [widx[t] for t in self._doc_tokens(d) if t in widx]
+                if not idx or not d.labels:
+                    continue
+                for lbl in d.labels:
+                    chunk.append((np.asarray(idx, np.int64),
+                                  V + self.label_index[lbl]))
+                if len(chunk) >= chunk_docs:
+                    self._fit_doc_chunk(chunk, total)
+                    chunk = []
+            if chunk:
+                self._fit_doc_chunk(chunk, total)
+        return self
+
+    def _fit_doc_chunk(self, chunk, total_expected):
+        seqs = [c[0] for c in chunk]
+        doc_rows = np.asarray([c[1] for c in chunk], np.int64)
+        for _ in range(self.iterations):
+            lr = self._lr(total_expected)
+            if self.dm:
+                centers, bags, bmask, rows = self._bags_with_docs(seqs, doc_rows)
+                if len(centers):
+                    # doc row joins each context bag in an extra column
+                    bags = np.concatenate([bags, rows[:, None]], axis=1)
+                    bmask = np.concatenate(
+                        [bmask, np.ones((len(bmask), 1), np.float32)], axis=1)
+                    self._train_bags(centers, bags, bmask, lr)
+            else:
+                # DBOW: the doc row is the input-side index for every word
+                flat = np.concatenate(seqs)
+                rows = np.repeat(doc_rows, [len(s) for s in seqs])
+                self._train_pairs(flat, rows, lr)
+            if self.train_words:
+                centers, contexts = self._pairs_for_chunk(seqs)
+                if len(centers):
+                    self._train_pairs(centers, contexts, lr)
+            self.words_processed += sum(len(s) for s in seqs)
+
+    def _bags_with_docs(self, seqs, doc_rows, rng=None):
+        """_bags_for_chunk plus the originating doc row per surviving center.
+        ``rng`` defaults to the model RNG; inference passes a seed-local one
+        so infer_vector never advances (or depends on) model state."""
+        rng = rng if rng is not None else self._rng
+        flat = np.concatenate(seqs)
+        sid = np.repeat(np.arange(len(seqs)), [len(s) for s in seqs])
+        flat, sid = self._subsample(flat, sid)
+        n = len(flat)
+        w = self.window_size
+        if n < 1:
+            return (np.zeros(0, np.int64), np.zeros((0, 2 * w), np.int64),
+                    np.zeros((0, 2 * w), np.float32), np.zeros(0, np.int64))
+        r = rng.integers(1, w + 1, n)
+        bags = np.zeros((n, 2 * w), np.int64)
+        mask = np.zeros((n, 2 * w), np.float32)
+        col = 0
+        for d in range(1, w + 1):
+            for sign in (-1, 1):
+                src = np.arange(n) + sign * d
+                ok = (src >= 0) & (src < n)
+                ok[ok] &= sid[src[ok]] == sid[ok.nonzero()[0]]
+                ok &= d <= r
+                bags[ok, col] = flat[src[ok]]
+                mask[ok, col] = 1.0
+                col += 1
+        # unlike plain CBOW, a bag may be empty: the doc row still predicts
+        return flat, bags, mask, doc_rows[sid]
+
+    # ------------------------------------------------------------- inference
+    def infer_vector(self, text: str, learning_rate: Optional[float] = None,
+                     iterations: int = 30, seed: int = 0) -> np.ndarray:
+        """Train a fresh doc vector against the frozen model (reference
+        ParagraphVectors.inferVector). Negative-sampling models only — the
+        reference's HS path would need a dedicated frozen-HS kernel."""
+        if self.syn0 is None:
+            raise ValueError("model is not trained")
+        if self.negative <= 0:
+            raise NotImplementedError(
+                "infer_vector requires negative sampling (negative > 0)")
+        widx = {vw.word: vw.index for vw in self.vocab.vocab_words()}
+        tokens = self.tokenizer_factory.create(text).get_tokens()
+        idx = np.asarray([widx[t] for t in tokens if t in widx], np.int64)
+        D = self.syn0.shape[1]
+        rng = np.random.default_rng(seed)
+        docvec = ((rng.random(D, np.float32) - 0.5) / D)
+        if len(idx) == 0:
+            return docvec
+        lr = np.float32(learning_rate if learning_rate is not None
+                        else self.learning_rate)
+        b = self.batch_size
+        syn0 = np.asarray(self.syn0)
+        syn1 = np.asarray(self.syn1)
+        if self.dm:
+            # build bags once without subsampling (inference is deterministic
+            # modulo the seed; subsampling is a training-time regularizer)
+            sampling, self.sampling = self.sampling, 0.0
+            try:
+                centers, bags, bmask, _ = self._bags_with_docs(
+                    [idx], np.zeros(1, np.int64), rng=rng)
+            finally:
+                self.sampling = sampling
+        else:
+            centers = idx
+        for _ in range(iterations):
+            for s in range(0, len(centers), b):
+                ce, wmask = self._pad(centers[s:s + b], b)
+                if wmask is None:
+                    wmask = np.ones(b, np.float32)
+                negs = self._neg_table[rng.integers(
+                    0, len(self._neg_table), (b, self.negative))].astype(np.int32)
+                if self.dm:
+                    bg, _ = self._pad(bags[s:s + b], b)
+                    bm, _ = self._pad(bmask[s:s + b], b)
+                    docvec, _ = kernels.cbow_infer_step(
+                        docvec, syn0, syn1, ce.astype(np.int32),
+                        bg.astype(np.int32), bm.astype(np.float32),
+                        negs, wmask, lr)
+                else:
+                    docvec, _ = kernels.sgns_infer_step(
+                        docvec, syn1, ce.astype(np.int32), negs, wmask, lr)
+        return np.asarray(docvec)
+
+    # ------------------------------------------------------------- accessors
+    def doc_vector(self, label: str) -> Optional[np.ndarray]:
+        i = self.label_index.get(label)
+        if i is None or self.syn0 is None:
+            return None
+        return np.asarray(self.syn0[self.vocab.num_words() + i])
+
+    def labels(self) -> List[str]:
+        return sorted(self.label_index, key=self.label_index.get)
+
+    def get_word_vector_matrix(self) -> np.ndarray:
+        return np.asarray(self.syn0[: self.vocab.num_words()])
+
+    def similarity_to_label(self, text: str, label: str) -> float:
+        """Cosine between an inferred vector for ``text`` and a trained doc
+        vector (reference predict/similarityToLabel)."""
+        v = self.infer_vector(text)
+        d = self.doc_vector(label)
+        if d is None:
+            return float("nan")
+        denom = (np.linalg.norm(v) * np.linalg.norm(d)) or 1e-12
+        return float(v @ d / denom)
+
+    def predict(self, text: str) -> Optional[str]:
+        """Most similar label for a text (reference predict)."""
+        if not self.label_index:
+            return None
+        v = self.infer_vector(text)
+        V = self.vocab.num_words()
+        docs = np.asarray(self.syn0[V:])
+        norms = np.linalg.norm(docs, axis=1) * (np.linalg.norm(v) or 1e-12)
+        sims = (docs @ v) / np.maximum(norms, 1e-12)
+        return self.labels()[int(np.argmax(sims))]

@@ -1,0 +1,212 @@
+"""NLP stack tests.
+
+Mirrors the reference's Word2Vec/ParagraphVectors/Glove test approach
+(deeplearning4j-nlp/src/test: train on a small corpus, assert similarity
+structure) with a synthetic two-topic corpus instead of the raw_sentences.txt
+resource: words within a topic co-occur, so trained embeddings must place
+same-topic words closer than cross-topic words — checkable without any
+downloaded fixture."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BasicLineIterator, CollectionSentenceIterator, Glove, LabelledDocument,
+    ParagraphVectors, SequenceVectors, Word2Vec, WordVectorSerializer,
+)
+from deeplearning4j_tpu.nlp.tokenization import (
+    CommonPreprocessor, DefaultTokenizerFactory, NGramTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import (
+    AbstractCache, VocabConstructor, VocabWord, build_huffman, unigram_table,
+)
+
+
+def two_topic_corpus(n=300, seed=7):
+    """Sentences drawn from two disjoint topical vocabularies."""
+    rng = np.random.default_rng(seed)
+    animals = ["cat", "dog", "horse", "cow", "sheep", "goat"]
+    tools = ["hammer", "wrench", "drill", "saw", "pliers", "chisel"]
+    sents = []
+    for _ in range(n):
+        pool = animals if rng.random() < 0.5 else tools
+        words = rng.choice(pool, size=rng.integers(4, 9))
+        sents.append(" ".join(words))
+    return sents, animals, tools
+
+
+def intra_vs_inter(model, animals, tools):
+    intra = np.mean([model.similarity(a, b)
+                     for a in animals for b in animals if a != b])
+    inter = np.mean([model.similarity(a, t) for a in animals for t in tools])
+    return intra, inter
+
+
+W2V_KW = dict(layer_size=32, window_size=3, epochs=20, batch_size=512,
+              learning_rate=0.3, min_word_frequency=1, seed=42)
+
+
+# ---------------------------------------------------------------- vocabulary
+def test_vocab_and_huffman():
+    corpus = [["a", "b", "a", "c"], ["a", "b"]]
+    cache = VocabConstructor(1).build_joint_vocabulary([corpus])
+    assert cache.num_words() == 3
+    assert cache.word_at_index(0) == "a"          # most frequent first
+    assert cache.word_frequency("a") == 3
+    codes, points, lengths = build_huffman(cache)
+    assert codes.shape == points.shape
+    assert (lengths >= 1).all()
+    # Huffman: most frequent word gets the shortest code
+    assert lengths[0] == lengths.min()
+    table = unigram_table(cache, table_size=1000)
+    assert table.shape == (1000,)
+    counts = np.bincount(table, minlength=3)
+    assert counts[0] > counts[2]                   # frequent word sampled more
+
+
+def test_tokenization():
+    t = DefaultTokenizerFactory()
+    t.set_token_pre_processor(CommonPreprocessor())
+    assert t.create("Hello, World! 123").get_tokens() == ["hello", "world"]
+    ng = NGramTokenizerFactory(min_n=1, max_n=2)
+    toks = ng.create("a b c").get_tokens()
+    assert "a b" in toks and "a" in toks
+
+
+# ------------------------------------------------------------------ word2vec
+# CBOW's mean-pooled bag divides each member's gradient by the bag size, so
+# it needs a higher lr at this corpus scale (the original word2vec ships a
+# higher default lr for CBOW, 0.05 vs 0.025, for the same reason)
+@pytest.mark.parametrize("negative,use_cbow,lr,epochs", [
+    (5, False, 0.3, 20), (0, False, 0.3, 20),
+    (5, True, 1.0, 40), (0, True, 0.3, 20)])
+def test_word2vec_topics(negative, use_cbow, lr, epochs):
+    """All four training modes (SG/CBOW x NS/HS) must learn topic structure."""
+    sents, animals, tools = two_topic_corpus(n=200)
+    kw = dict(W2V_KW, learning_rate=lr, epochs=epochs)
+    model = Word2Vec(negative=negative, use_cbow=use_cbow, **kw)
+    model.fit(sents)
+    assert model.vocab_size() == 12
+    intra, inter = intra_vs_inter(model, animals, tools)
+    assert intra > inter + 0.3, f"intra={intra:.3f} inter={inter:.3f}"
+
+
+def test_word2vec_nearest_and_iterator(tmp_path):
+    sents, animals, tools = two_topic_corpus()
+    path = tmp_path / "corpus.txt"
+    path.write_text("\n".join(sents))
+    model = Word2Vec(sentence_iterator=BasicLineIterator(str(path)), **W2V_KW)
+    model.fit()
+    near = model.words_nearest("cat", top_n=5)
+    assert len(set(near) & set(animals)) >= 3, near
+    assert model.has_word("dog") and not model.has_word("xyzzy")
+
+
+# ------------------------------------------------------------- serialization
+def test_serializer_roundtrips(tmp_path):
+    sents, animals, _ = two_topic_corpus(n=60)
+    model = Word2Vec(**W2V_KW)
+    model.fit(sents)
+    txt, binp, zipp = (str(tmp_path / n) for n in
+                       ("vecs.txt", "vecs.bin", "model.zip"))
+    WordVectorSerializer.write_word_vectors(model, txt)
+    WordVectorSerializer.write_word2vec_binary(model, binp)
+    WordVectorSerializer.write_word2vec_model(model, zipp)
+    for loaded in (WordVectorSerializer.read_word_vectors(txt),
+                   WordVectorSerializer.read_word2vec_binary(binp),
+                   WordVectorSerializer.read_word2vec_model(zipp)):
+        v0 = model.word_vector("cat")
+        v1 = loaded.word_vector("cat")
+        np.testing.assert_allclose(v0, v1, rtol=1e-4, atol=1e-5)
+    # restored full model can continue training
+    cont = WordVectorSerializer.read_word2vec_model(zipp)
+    cont.fit(sents)
+
+
+# ------------------------------------------------------------------ doc2vec
+@pytest.mark.parametrize("dm", [True, False])
+def test_paragraphvectors(dm):
+    sents, animals, tools = two_topic_corpus(n=200)
+    docs = [LabelledDocument(s, ["ANIMALS" if any(w in s for w in animals)
+                                 else "TOOLS"]) for s in sents]
+    pv = ParagraphVectors(dm=dm, train_words=True, **W2V_KW)
+    pv.fit(docs)
+    assert set(pv.labels()) == {"ANIMALS", "TOOLS"}
+    da, dt = pv.doc_vector("ANIMALS"), pv.doc_vector("TOOLS")
+    assert da is not None and dt is not None and not np.allclose(da, dt)
+    # inferred vector for an animal text lands closer to ANIMALS
+    assert pv.predict("cat dog horse cow dog cat") == "ANIMALS"
+    assert pv.predict("hammer wrench saw drill saw") == "TOOLS"
+
+
+@pytest.mark.parametrize("dm", [True, False])
+def test_paragraphvectors_infer_deterministic(dm):
+    """infer_vector must be repeatable and must not mutate model state
+    (round-3 review finding: DM's dynamic-window draw used the model RNG)."""
+    sents, _, _ = two_topic_corpus(n=80)
+    pv = ParagraphVectors(dm=dm, **W2V_KW)
+    pv.fit(sents[:50])
+    rng_state = pv._rng.bit_generator.state
+    v1 = pv.infer_vector("cat dog horse", seed=3)
+    v2 = pv.infer_vector("cat dog horse", seed=3)
+    np.testing.assert_allclose(v1, v2)
+    assert pv._rng.bit_generator.state == rng_state
+
+
+def test_paragraphvectors_refit_new_labels():
+    """Refitting with unseen labels must grow the doc table (review finding:
+    out-of-bounds scatters were silently dropped)."""
+    sents, animals, tools = two_topic_corpus(n=60)
+    pv = ParagraphVectors(dm=False, **dict(W2V_KW, epochs=2))
+    pv.fit([LabelledDocument(s, ["A"]) for s in sents[:20]])
+    pv.fit([LabelledDocument(s, ["B"]) for s in sents[20:40]])
+    assert set(pv.labels()) == {"A", "B"}
+    vb = pv.doc_vector("B")
+    assert vb is not None and np.abs(vb).max() > 0
+
+
+def test_paragraphvectors_words_nearest_excludes_docs():
+    """words_nearest must scan word rows only, never doc rows (review
+    finding: doc rows yielded None entries)."""
+    sents, animals, tools = two_topic_corpus(n=60)
+    pv = ParagraphVectors(dm=False, train_words=True, **W2V_KW)
+    pv.fit(sents)
+    near = pv.words_nearest("cat", top_n=11)
+    assert None not in near
+    assert len(near) == 11
+
+
+# --------------------------------------------------------------------- glove
+def test_glove_topics():
+    sents, animals, tools = two_topic_corpus(n=400)
+    g = Glove(layer_size=32, window_size=3, epochs=30, batch_size=512,
+              min_word_frequency=1, seed=1)
+    g.fit(sents)
+    assert len(g.loss_history) == 30
+    assert g.loss_history[-1] < g.loss_history[0]   # objective decreases
+    intra, inter = intra_vs_inter(g, animals, tools)
+    assert intra > inter, f"intra={intra:.3f} inter={inter:.3f}"
+
+
+# ---------------------------------------------------------- sequencevectors
+def test_sequencevectors_generic():
+    """SequenceVectors trains arbitrary token sequences (the DeepWalk /
+    ParagraphVectors substrate — reference SequenceVectors genericity)."""
+    rng = np.random.default_rng(0)
+    seqs = [[f"n{rng.integers(0, 5)}" for _ in range(8)] for _ in range(50)]
+    sv = SequenceVectors(layer_size=16, window_size=2, negative=3, epochs=3,
+                         batch_size=128, seed=0)
+    sv.fit(lambda: iter(seqs))
+    assert sv.get_word_vector_matrix().shape == (5, 16)
+
+
+def test_cbow_hs_no_crash():
+    """Regression: CBOW + hierarchical softmax (negative=0) used to crash on
+    a None negative table (round-2 advisor finding)."""
+    sents, _, _ = two_topic_corpus(n=30)
+    model = Word2Vec(negative=0, use_cbow=True, layer_size=8, epochs=1,
+                     batch_size=64)
+    model.fit(sents)
+    assert model.word_vector("cat") is not None
