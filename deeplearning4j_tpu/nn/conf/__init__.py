@@ -9,3 +9,6 @@ from deeplearning4j_tpu.nn.conf import convolutional as _conv  # noqa: F401,E402
 from deeplearning4j_tpu.nn.conf import normalization as _norm  # noqa: F401,E402
 from deeplearning4j_tpu.nn.conf import pooling as _pool  # noqa: F401,E402
 from deeplearning4j_tpu.nn.conf import recurrent as _rnn  # noqa: F401,E402
+from deeplearning4j_tpu.nn.conf import objdetect as _objdetect  # noqa: F401,E402
+from deeplearning4j_tpu.nn.conf import pretrain as _pretrain  # noqa: F401,E402
+from deeplearning4j_tpu.nn.conf import variational as _vae  # noqa: F401,E402

@@ -260,10 +260,17 @@ class BaseOutputLayer(BaseLayer):
         return True
 
     def pre_output(self, params, x):
+        """May return a pytree for layers whose score needs more than the
+        logits (CenterLoss carries features+centers; YOLO the raw grid)."""
         z = x @ params["W"]
         if "b" in params:
             z = z + params["b"]
         return z
+
+    def output_activations(self, preout):
+        """preout -> network predictions (the networks call this instead of
+        applying ``activation`` directly, so structured preouts work)."""
+        return get_activation(self.activation)(preout)
 
     def compute_score(self, labels, preout, mask=None):
         return lossfunctions.score(self.loss, labels, preout, self.activation,
@@ -304,6 +311,76 @@ class OutputLayer(BaseOutputLayer):
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         x = dropout_input(x, self.dropout, train, rng)
         return get_activation(self.activation)(self.pre_output(params, x)), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class CenterLossOutputLayer(OutputLayer):
+    """Softmax output + center loss (reference
+    nn/conf/layers/CenterLossOutputLayer.java: alpha=0.05, lambda=2e-4;
+    nn/layers/training/CenterLossOutputLayer.java:35).
+
+    Loss = interclass(labels, softmax) + lambda/2 * mean ||f - c_y||^2 where
+    f is the layer input (the embedding) and c_y the per-class center.
+
+    Center updates mirror the reference's hand-crafted rule (centers move
+    toward the class mean of the features with rate alpha, normalized by
+    class count + 1 — CenterLossOutputLayer.java:209-224): that direction is
+    injected as the autodiff gradient of a value-neutral pseudo-term, so any
+    updater works on the other params while centers follow the reference
+    dynamics."""
+
+    alpha: float = 0.05
+    lamda: float = 2e-4   # "lambda" is a Python keyword; JSON key is "lamda"
+    # reference's gradientCheck flag (CenterLossOutputLayer.java:218): centers
+    # take the TRUE loss gradient instead of the alpha EMA direction, so
+    # finite-difference checks pass
+    gradient_check: bool = False
+
+    def init(self, rng, input_type, dtype=jnp.float32):
+        params, state = super().init(rng, input_type, dtype)
+        n_in = self.n_in or input_type.flat_size()
+        # centers start at zero (reference CenterLossParamInitializer)
+        params["cL"] = jnp.zeros((self.n_out, n_in), dtype)
+        return params, state
+
+    def pre_output(self, params, x):
+        z = x @ params["W"]
+        if "b" in params:
+            z = z + params["b"]
+        # score needs the features and centers too: carry them as a pytree
+        return {"z": z, "f": x, "cL": params["cL"]}
+
+    def output_activations(self, preout):
+        return get_activation(self.activation)(preout["z"])
+
+    def compute_score(self, labels, preout, mask=None):
+        inter = lossfunctions.score(self.loss, labels, preout["z"],
+                                    self.activation, mask, self.loss_weights)
+        if self.gradient_check:
+            centers_y = labels @ preout["cL"]             # true gradient mode
+            diff = preout["f"] - centers_y
+            return inter + 0.5 * self.lamda * jnp.mean(jnp.sum(diff * diff, -1))
+        centers_y = labels @ jax.lax.stop_gradient(preout["cL"])  # (B, n_in)
+        diff = preout["f"] - centers_y
+        intra = 0.5 * self.lamda * jnp.mean(jnp.sum(diff * diff, -1))
+        # value-neutral term whose gradient w.r.t. centers reproduces the
+        # reference's alpha * sum(c_y - f) / (count_y + 1) update direction
+        counts = jnp.sum(labels, 0)                       # (n_out,)
+        w_per_ex = labels @ (1.0 / (counts + 1.0))        # (B,)
+        cdiff = labels @ preout["cL"] - jax.lax.stop_gradient(preout["f"])
+        pseudo = 0.5 * self.alpha * jnp.sum(
+            w_per_ex[:, None] * cdiff * cdiff)
+        pseudo = pseudo - jax.lax.stop_gradient(pseudo)   # grad only, no value
+        return inter + intra + pseudo
+
+    def compute_score_array(self, labels, preout, mask=None):
+        inter = lossfunctions.score_array(self.loss, labels, preout["z"],
+                                          self.activation, mask,
+                                          self.loss_weights)
+        centers_y = labels @ preout["cL"]
+        intra = 0.5 * self.lamda * jnp.sum((preout["f"] - centers_y) ** 2, -1)
+        return inter + intra
 
 
 @register_layer

@@ -1,0 +1,102 @@
+"""Pretrainable feed-forward layers (denoising AutoEncoder).
+
+Parity surface: reference ``nn/conf/layers/AutoEncoder.java`` (builder:
+corruptionLevel=0.3, sparsity) + ``nn/layers/feedforward/autoencoder/
+AutoEncoder.java`` (encode/decode with tied weights W / W^T and a visible
+bias), on top of ``nn/conf/layers/BasePretrainNetwork.java`` /
+``nn/layers/BasePretrainNetwork.java:37`` (the layerwise-pretraining
+contract MultiLayerNetwork.pretrain drives).
+
+TPU-native: pretraining is a jitted loss on the corrupted input; autodiff
+replaces the hand-written W/b/vb gradient assembly of the reference
+(AutoEncoder.java:123). RBM is intentionally not replicated: contrastive
+divergence is a pre-2012 technique the reference itself deprecated, and the
+denoising AE + VAE cover the pretraining capability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import BaseLayer, register_layer
+from deeplearning4j_tpu.nn.initializers import init_weights
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class AutoEncoder(BaseLayer):
+    """Denoising autoencoder layer.
+
+    Supervised forward = encode(x). Pretraining reconstructs the clean input
+    from a masking-corrupted copy (``corruption_level`` = probability an
+    input unit is zeroed, reference getCorruptedInput). ``loss``: 'mse' or
+    'xent' (binary cross-entropy — use with sigmoid activation and [0,1]
+    data, the reference's RECONSTRUCTION_CROSSENTROPY analogue)."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    corruption_level: float = 0.3
+    sparsity: float = 0.0
+    loss: str = "mse"
+    activation: str = "sigmoid"
+
+    def input_kind(self):
+        return "ff"
+
+    def is_pretrainable(self):
+        return True
+
+    def output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, rng, input_type, dtype=jnp.float32):
+        n_in = self.n_in or input_type.flat_size()
+        k_w, _ = jax.random.split(rng)
+        return {
+            "W": init_weights(k_w, (n_in, self.n_out), n_in, self.n_out,
+                              self.weight_init, self.dist, dtype),
+            "b": jnp.full((self.n_out,), self.bias_init, dtype),
+            "vb": jnp.full((n_in,), self.bias_init, dtype),
+        }, {}
+
+    # --------------------------------------------------------------- forward
+    def encode(self, params, x):
+        return get_activation(self.activation)(x @ params["W"] + params["b"])
+
+    def decode(self, params, h):
+        """Tied weights: decode through W^T (reference decode :71)."""
+        return get_activation(self.activation)(h @ params["W"].T + params["vb"])
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self.encode(params, x), state
+
+    # -------------------------------------------------------------- pretrain
+    def pretrain_loss(self, params, state, x, rng):
+        x_in = x
+        if self.corruption_level > 0 and rng is not None:
+            rng, k = jax.random.split(rng)
+            keep = jax.random.bernoulli(k, 1.0 - self.corruption_level, x.shape)
+            x_in = jnp.where(keep, x, 0.0).astype(x.dtype)
+        h = self.encode(params, x_in)
+        z = self.decode(params, h)
+        if self.loss == "mse":
+            recon = jnp.mean(jnp.sum((z - x) ** 2, -1))
+        elif self.loss == "xent":
+            eps = 1e-7
+            recon = jnp.mean(-jnp.sum(
+                x * jnp.log(z + eps) + (1 - x) * jnp.log(1 - z + eps), -1))
+        else:
+            raise ValueError(self.loss)
+        if self.sparsity > 0:
+            # KL(sparsity || mean activation) sparsity penalty
+            rho = self.sparsity
+            rho_hat = jnp.clip(jnp.mean(h, 0), 1e-6, 1 - 1e-6)
+            recon = recon + jnp.sum(rho * jnp.log(rho / rho_hat) +
+                                    (1 - rho) * jnp.log((1 - rho) / (1 - rho_hat)))
+        return recon

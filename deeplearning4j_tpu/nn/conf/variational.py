@@ -1,0 +1,182 @@
+"""Variational autoencoder layer.
+
+Parity surface: reference
+``nn/conf/layers/variational/VariationalAutoencoder.java`` (builder:
+encoderLayerSizes/decoderLayerSizes, pzxActivationFunction, numSamples,
+reconstruction distribution) and
+``nn/layers/variational/VariationalAutoencoder.java:68`` (1,163 LoC of
+hand-written forward/backward); reconstruction distributions
+``variational/BernoulliReconstructionDistribution.java`` and
+``GaussianReconstructionDistribution.java``.
+
+TPU-native redesign: the reference hand-derives every gradient of the ELBO
+through encoder, reparameterization and decoder; here ``pretrain_loss`` is a
+~30-line traced expression (reparameterized sample + closed-form KL) and
+autodiff does the rest. In a supervised stack the layer's ``apply`` returns
+the mean of q(z|x) — identical to the reference's ``activate``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import BaseLayer, register_layer
+from deeplearning4j_tpu.nn.initializers import init_weights
+
+
+def _mlp_init(rng, sizes, weight_init, dist, bias_init, dtype, prefix):
+    params = {}
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        rng, k = jax.random.split(rng)
+        params[f"{prefix}{i}W"] = init_weights(k, (a, b), a, b, weight_init,
+                                               dist, dtype)
+        params[f"{prefix}{i}b"] = jnp.full((b,), bias_init, dtype)
+    return params, rng
+
+
+def _mlp_apply(params, x, n, act, prefix):
+    for i in range(n):
+        x = act(x @ params[f"{prefix}{i}W"] + params[f"{prefix}{i}b"])
+    return x
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class VariationalAutoencoder(BaseLayer):
+    """VAE as a layer: supervised forward = mean of q(z|x); unsupervised
+    pretraining maximizes the ELBO (see module docstring).
+
+    ``reconstruction``: 'bernoulli' (sigmoid + binary cross-entropy — data in
+    [0,1]) or 'gaussian' (identity mean + learned diagonal log-variance) —
+    the two reference ReconstructionDistributions that cover the test suite.
+    ``pzx_activation``: activation on the q(z|x) mean/logvar pre-outs
+    (reference pzxActivationFunction, default identity).
+    """
+
+    n_in: Optional[int] = None
+    n_out: int = 0                       # latent size
+    encoder_layer_sizes: Tuple[int, ...] = (100,)
+    decoder_layer_sizes: Tuple[int, ...] = (100,)
+    pzx_activation: str = "identity"
+    reconstruction: str = "bernoulli"
+    num_samples: int = 1
+    activation: str = "tanh"             # encoder/decoder hidden activation
+
+    def input_kind(self):
+        return "ff"
+
+    def is_pretrainable(self):
+        return True
+
+    def output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+    # ------------------------------------------------------------------ init
+    def init(self, rng, input_type, dtype=jnp.float32):
+        n_in = self.n_in or input_type.flat_size()
+        enc = (n_in,) + tuple(self.encoder_layer_sizes)
+        dec = (self.n_out,) + tuple(self.decoder_layer_sizes)
+        params, rng = _mlp_init(rng, enc, self.weight_init, self.dist,
+                                self.bias_init, dtype, "e")
+        dparams, rng = _mlp_init(rng, dec, self.weight_init, self.dist,
+                                 self.bias_init, dtype, "d")
+        params.update(dparams)
+        eh = enc[-1]
+        dh = dec[-1]
+        recon_out = n_in if self.reconstruction == "bernoulli" else 2 * n_in
+        for name, (a, b) in (("pzxMean", (eh, self.n_out)),
+                             ("pzxLogStd2", (eh, self.n_out)),
+                             ("pxz", (dh, recon_out))):
+            rng, k = jax.random.split(rng)
+            params[name + "W"] = init_weights(k, (a, b), a, b,
+                                              self.weight_init, self.dist, dtype)
+            params[name + "b"] = jnp.full((b,), self.bias_init, dtype)
+        return params, {}
+
+    def regularizable(self):
+        return tuple(k for k in
+                     [f"e{i}W" for i in range(len(self.encoder_layer_sizes))]
+                     + [f"d{i}W" for i in range(len(self.decoder_layer_sizes))]
+                     + ["pzxMeanW", "pzxLogStd2W", "pxzW"])
+
+    # --------------------------------------------------------------- forward
+    def _encode(self, params, x):
+        act = get_activation(self.activation)
+        h = _mlp_apply(params, x, len(self.encoder_layer_sizes), act, "e")
+        pzx_act = get_activation(self.pzx_activation)
+        mean = pzx_act(h @ params["pzxMeanW"] + params["pzxMeanb"])
+        logvar = pzx_act(h @ params["pzxLogStd2W"] + params["pzxLogStd2b"])
+        return mean, logvar
+
+    def _decode(self, params, z):
+        act = get_activation(self.activation)
+        h = _mlp_apply(params, z, len(self.decoder_layer_sizes), act, "d")
+        return h @ params["pxzW"] + params["pxzb"]
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        """Supervised forward: mean of q(z|x) (reference activate :804)."""
+        mean, _ = self._encode(params, x)
+        return mean, state
+
+    # -------------------------------------------------------------- pretrain
+    def pretrain_loss(self, params, state, x, rng):
+        """Negative ELBO, averaged over the minibatch (reference
+        computeGradientAndScore with numSamples reparameterized draws):
+        E_q[-log p(x|z)] + KL(q(z|x) || N(0, I))."""
+        mean, logvar = self._encode(params, x)
+        # closed-form KL per example: -0.5 * sum(1 + log s2 - m^2 - s2)
+        kl = -0.5 * jnp.sum(1.0 + logvar - mean ** 2 - jnp.exp(logvar), -1)
+        recon = 0.0
+        for s in range(self.num_samples):
+            rng, k = jax.random.split(rng)
+            eps = jax.random.normal(k, mean.shape, mean.dtype)
+            z = mean + jnp.exp(0.5 * logvar) * eps
+            p = self._decode(params, z)
+            if self.reconstruction == "bernoulli":
+                # sigmoid + binary CE, numerically fused on logits
+                nll = jnp.sum(jnp.maximum(p, 0) - p * x +
+                              jnp.log1p(jnp.exp(-jnp.abs(p))), -1)
+            elif self.reconstruction == "gaussian":
+                mu, lv = jnp.split(p, 2, axis=-1)
+                nll = 0.5 * jnp.sum(lv + (x - mu) ** 2 / jnp.exp(lv)
+                                    + jnp.log(2 * jnp.pi), -1)
+            else:
+                raise ValueError(self.reconstruction)
+            recon = recon + nll
+        recon = recon / self.num_samples
+        return jnp.mean(recon + kl)
+
+    # ------------------------------------------------------------- utilities
+    def reconstruction_probability(self, params, x, rng, num_samples=5):
+        """Monte-carlo estimate of log p(x) (reference
+        reconstructionLogProbability — used for anomaly detection)."""
+        mean, logvar = self._encode(params, x)
+        total = None
+        for s in range(num_samples):
+            rng, k = jax.random.split(rng)
+            eps = jax.random.normal(k, mean.shape, mean.dtype)
+            z = mean + jnp.exp(0.5 * logvar) * eps
+            p = self._decode(params, z)
+            if self.reconstruction == "bernoulli":
+                logp = -jnp.sum(jnp.maximum(p, 0) - p * x +
+                                jnp.log1p(jnp.exp(-jnp.abs(p))), -1)
+            else:
+                mu, lv = jnp.split(p, 2, axis=-1)
+                logp = -0.5 * jnp.sum(lv + (x - mu) ** 2 / jnp.exp(lv)
+                                      + jnp.log(2 * jnp.pi), -1)
+            total = logp if total is None else jnp.logaddexp(total, logp)
+        return total - jnp.log(float(num_samples))
+
+    def generate_at_mean_given_z(self, params, z):
+        """Decoder mean for a latent (reference generateAtMeanGivenZ)."""
+        p = self._decode(params, z)
+        if self.reconstruction == "bernoulli":
+            return jax.nn.sigmoid(p)
+        mu, _ = jnp.split(p, 2, axis=-1)
+        return mu

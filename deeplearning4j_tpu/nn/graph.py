@@ -128,10 +128,12 @@ class ComputationGraph:
                 if obj.is_output_layer():
                     x_in = dropout_input(xs[0], obj.dropout, train, k)
                     z = obj.pre_output(params[name], x_in)
-                    if z.dtype in (jnp.bfloat16, jnp.float16):
-                        z = z.astype(jnp.float32)
+                    # loss math in f32 (z may be a pytree: CenterLoss/YOLO)
+                    z = jax.tree_util.tree_map(
+                        lambda a: a.astype(jnp.float32)
+                        if a.dtype in (jnp.bfloat16, jnp.float16) else a, z)
                     preouts[name] = z
-                    out = get_activation(obj.activation)(z)
+                    out = obj.output_activations(z)
                     new_state[name] = state[name]
                 else:
                     out, st = obj.apply(params[name], state[name], xs[0],
@@ -264,19 +266,26 @@ class ComputationGraph:
         self.iteration += 1
 
     # ---------------------------------------------------------------- output
-    def output(self, *inputs) -> List[np.ndarray]:
-        """Multi-output inference (reference ComputationGraph.output)."""
+    def output(self, *inputs, features_masks=None) -> List[np.ndarray]:
+        """Multi-output inference (reference ComputationGraph.output; the
+        mask-threading overload ComputationGraph.java:1428 — masked sequence
+        vertices like Bidirectional/LastTimeStep read only valid steps)."""
         if self.params is None:
             self.init()
         fn = self._get_jitted("output")
-        outs = fn(self.params, self.state, [jnp.asarray(x) for x in inputs], None)
+        fmasks = (None if features_masks is None else
+                  [None if m is None else jnp.asarray(m)
+                   for m in features_masks])
+        outs = fn(self.params, self.state,
+                  [jnp.asarray(x) for x in inputs], fmasks)
         return [np.asarray(o) for o in outs]
 
-    def output_single(self, *inputs) -> np.ndarray:
-        return self.output(*inputs)[0]
+    def output_single(self, *inputs, features_masks=None) -> np.ndarray:
+        return self.output(*inputs, features_masks=features_masks)[0]
 
-    def predict(self, *inputs) -> np.ndarray:
-        return np.argmax(self.output_single(*inputs), axis=-1)
+    def predict(self, *inputs, features_masks=None) -> np.ndarray:
+        return np.argmax(
+            self.output_single(*inputs, features_masks=features_masks), axis=-1)
 
     def score_dataset(self, ds) -> float:
         mds = MultiDataSet.from_dataset(ds) if isinstance(ds, DataSet) else ds
@@ -290,9 +299,13 @@ class ComputationGraph:
                         [jnp.asarray(l) for l in mds.labels], fmasks, lmasks))
 
     def evaluate(self, iterator):
+        """Classification eval over an iterator (reference
+        ComputationGraph.evaluate), threading the dataset's feature masks
+        through inference like the MLN path does."""
         from deeplearning4j_tpu.eval.evaluation import Evaluation
         e = Evaluation()
         for ds in iterator:
-            out = self.output_single(ds.features)
+            fm = None if ds.features_mask is None else [ds.features_mask]
+            out = self.output_single(ds.features, features_masks=fm)
             e.eval(ds.labels, out, mask=ds.labels_mask)
         return e

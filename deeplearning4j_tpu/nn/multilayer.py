@@ -132,9 +132,11 @@ class MultiLayerNetwork:
             if i == n - 1 and layer.is_output_layer():
                 x_in = dropout_input(x, layer.dropout, train, k)
                 preout = layer.pre_output(params[i], x_in)
-                if preout.dtype in (jnp.bfloat16, jnp.float16):
-                    preout = preout.astype(jnp.float32)  # loss math in f32
-                x = get_activation(layer.activation)(preout)
+                # loss math in f32 (preout may be a pytree: CenterLoss/YOLO)
+                preout = jax.tree_util.tree_map(
+                    lambda a: a.astype(jnp.float32)
+                    if a.dtype in (jnp.bfloat16, jnp.float16) else a, preout)
+                x = layer.output_activations(preout)
                 new_state.append(state[i])
                 new_carries.append({})
             elif (carries is not None and hasattr(layer, "apply_seq")
@@ -315,6 +317,78 @@ class MultiLayerNetwork:
                 raise KeyError(kind)
             self._jit_cache[k] = fn
         return fn
+
+    # -------------------------------------------------------------- pretrain
+    def _featurize(self, params, state, x, upto: int):
+        """Inference-mode forward through layers[0:upto] (+ the preprocessor
+        feeding layer ``upto``) — the input to the pretraining layer."""
+        cur_mask = None
+        for j in range(upto):
+            if j in self._pre:
+                x, cur_mask = self._pre[j].apply(x, cur_mask)
+            x, _ = self.layers[j].apply(params[j], state[j], x, train=False,
+                                        rng=None, mask=cur_mask)
+        if upto in self._pre:
+            x, _ = self._pre[upto].apply(x, cur_mask)
+        return x
+
+    def pretrain(self, data, num_epochs: int = 1):
+        """Greedy layerwise pretraining of every pretrainable layer (AE/VAE),
+        in order (reference MultiLayerNetwork.pretrain :1172 /
+        pretrainLayer)."""
+        if self.params is None:
+            self.init()
+        for i, layer in enumerate(self.layers):
+            if getattr(layer, "is_pretrainable", lambda: False)():
+                self.pretrain_layer(i, data, num_epochs)
+        return self
+
+    def pretrain_layer(self, i: int, data, num_epochs: int = 1):
+        """Pretrain one layer: featurize through the frozen stack below, then
+        minimize the layer's unsupervised ``pretrain_loss`` — one jitted step
+        per minibatch, updating only that layer's params (reference
+        pretrainLayer(int layerIdx, DataSetIterator))."""
+        layer = self.layers[i]
+        if not getattr(layer, "is_pretrainable", lambda: False)():
+            raise ValueError(f"layer {i} ({type(layer).__name__}) is not "
+                             "pretrainable")
+        if self.params is None:
+            self.init()
+        if isinstance(data, DataSet):
+            data = [data]
+        key = ("pretrain", i)
+        step = self._jit_cache.get(key)
+        if step is None:
+            # frozen stack below passed separately from the (donated)
+            # trainable layer params — the same buffer must not be both
+            def loss_fn(p_i, below_params, below_state, s_i, x, rng):
+                feats = self._featurize(below_params, below_state, x, i)
+                return layer.pretrain_loss(p_i, s_i, feats, rng)
+
+            grad_fn = jax.value_and_grad(loss_fn)
+
+            def step(p_i, opt_i, below_params, below_state, s_i, rng, x):
+                loss, g = grad_fn(p_i, below_params, below_state, s_i, x, rng)
+                g = self._gnorms[i](g)
+                updates, opt_i = self._txs[i].update(g, opt_i, p_i)
+                return optax.apply_updates(p_i, updates), opt_i, loss
+
+            step = jax.jit(step, donate_argnums=(0, 1))
+            self._jit_cache[key] = step
+        for _ in range(num_epochs):
+            for ds in data:
+                x = jnp.asarray(ds.features if isinstance(ds, DataSet) else ds)
+                self._rng, k = jax.random.split(self._rng)
+                p_i, opt_i, loss = step(self.params[i], self.opt_state[i],
+                                        self.params[:i], self.state[:i],
+                                        self.state[i], k, x)
+                self.params[i] = p_i
+                self.opt_state[i] = opt_i
+                self._score = loss
+                for listener in self.listeners:
+                    listener.iteration_done(self, self.iteration, self.epoch)
+                self.iteration += 1
+        return self
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, num_epochs: int = 1):
