@@ -1,3 +1,7 @@
 from deeplearning4j_tpu.eval.evaluation import Evaluation, EvaluationBinary  # noqa: F401
 from deeplearning4j_tpu.eval.regression import RegressionEvaluation  # noqa: F401
-from deeplearning4j_tpu.eval.roc import ROC, ROCMultiClass  # noqa: F401
+from deeplearning4j_tpu.eval.roc import ROC, ROCBinary, ROCMultiClass  # noqa: F401
+from deeplearning4j_tpu.eval.calibration import EvaluationCalibration  # noqa: F401
+from deeplearning4j_tpu.eval.curves import (  # noqa: F401
+    Histogram, PrecisionRecallCurve, ReliabilityDiagram, RocCurve,
+)

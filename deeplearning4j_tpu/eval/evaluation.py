@@ -32,9 +32,13 @@ class ConfusionMatrix:
 class Evaluation:
     """Accuracy / precision / recall / F1 / confusion matrix (see module doc)."""
 
-    def __init__(self, n_classes: Optional[int] = None, labels: Optional[List[str]] = None):
+    def __init__(self, n_classes: Optional[int] = None, labels: Optional[List[str]] = None,
+                 top_n: int = 1):
         self.n_classes = n_classes
         self.label_names = labels
+        self.top_n = max(1, int(top_n))
+        self._top_n_correct = 0
+        self._top_n_total = 0
         self.confusion: Optional[ConfusionMatrix] = None
 
     def _ensure(self, n):
@@ -58,10 +62,19 @@ class Evaluation:
         self._ensure(labels.shape[-1])
         actual = np.argmax(labels.reshape(-1, labels.shape[-1]), axis=-1)
         pred = np.argmax(predictions.reshape(-1, predictions.shape[-1]), axis=-1)
+        flat_preds = predictions.reshape(-1, predictions.shape[-1])
         if mask is not None:
             m = np.asarray(mask).reshape(-1).astype(bool)
             actual, pred = actual[m], pred[m]
+            flat_preds = flat_preds[m]
         self.confusion.add(actual, pred)
+        if self.top_n > 1:
+            # top-N accuracy (reference Evaluation.java topNCorrectCount,
+            # constructor Evaluation(List<String> labels, int topN))
+            k = min(self.top_n, flat_preds.shape[-1])
+            topk = np.argpartition(-flat_preds, k - 1, axis=-1)[:, :k]
+            self._top_n_correct += int((topk == actual[:, None]).any(axis=-1).sum())
+            self._top_n_total += len(actual)
 
     # ---- metrics ----
     def _tp(self, i):
@@ -99,6 +112,14 @@ class Evaluation:
         p = self.precision(cls)
         r = self.recall(cls)
         return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def top_n_accuracy(self) -> float:
+        """Fraction of examples whose true class is in the top-N predicted
+        probabilities (reference Evaluation.topNAccuracy :1187)."""
+        if self.top_n == 1:
+            return self.accuracy()
+        return (self._top_n_correct / self._top_n_total
+                if self._top_n_total else 0.0)
 
     def false_positive_rate(self, cls: int) -> float:
         m = self.confusion.matrix

@@ -16,11 +16,23 @@ import numpy as np
 
 
 class ROC:
-    """Binary ROC: positive class probability vs binary label."""
+    """Binary ROC: positive class probability vs binary label.
 
-    def __init__(self):
+    ``threshold_steps=0`` (default) is the reference's exact mode: raw
+    (score, label) pairs are retained and AUROC is computed by rank
+    statistics. ``threshold_steps=N`` is the thresholded mode
+    (ROC.java:163 pre-0.9.x default): scores are histogrammed into N
+    equal-width bins so memory stays O(N) regardless of eval-set size —
+    use it for very large evaluations.
+    """
+
+    def __init__(self, threshold_steps: int = 0):
+        self.threshold_steps = int(threshold_steps)
         self._scores: List[np.ndarray] = []
         self._labels: List[np.ndarray] = []
+        if self.threshold_steps > 0:
+            self._pos_hist = np.zeros(self.threshold_steps, np.int64)
+            self._neg_hist = np.zeros(self.threshold_steps, np.int64)
 
     def eval(self, labels, predictions, mask=None):
         """labels: (n,) {0,1} or one-hot (n,2) (positive = column 1);
@@ -35,15 +47,37 @@ class ROC:
         if mask is not None:
             m = np.asarray(mask).reshape(-1).astype(bool)
             labels, preds = labels[m], preds[m]
+        if self.threshold_steps > 0:
+            idx = np.clip((preds * self.threshold_steps).astype(np.int64),
+                          0, self.threshold_steps - 1)
+            pos = labels > 0.5
+            np.add.at(self._pos_hist, idx[pos], 1)
+            np.add.at(self._neg_hist, idx[~pos], 1)
+            return
         self._labels.append(labels.astype(np.float64))
         self._scores.append(preds.astype(np.float64))
 
     def _collect(self) -> Tuple[np.ndarray, np.ndarray]:
         return np.concatenate(self._scores), np.concatenate(self._labels)
 
+    def _thresholded_rates(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(thresholds, fpr, tpr) from the histograms. ``>= threshold``
+        counts are suffix sums of the bin histograms."""
+        pos_ge = np.concatenate([np.cumsum(self._pos_hist[::-1])[::-1], [0]])
+        neg_ge = np.concatenate([np.cumsum(self._neg_hist[::-1])[::-1], [0]])
+        thresholds = np.linspace(0.0, 1.0, self.threshold_steps + 1)
+        tpr = pos_ge / max(self._pos_hist.sum(), 1)
+        fpr = neg_ge / max(self._neg_hist.sum(), 1)
+        return thresholds, fpr, tpr
+
     def calculate_auc(self) -> float:
         """AUROC via the Mann-Whitney U statistic (rank sum), equivalent to
-        the reference's exact-mode trapezoidal AUC."""
+        the reference's exact-mode trapezoidal AUC. In thresholded mode,
+        trapezoidal area under the binned curve."""
+        if self.threshold_steps > 0:
+            _, fpr, tpr = self._thresholded_rates()
+            order = np.argsort(fpr, kind="mergesort")
+            return float(np.trapezoid(tpr[order], fpr[order]))
         s, y = self._collect()
         pos = s[y > 0.5]
         neg = s[y <= 0.5]
@@ -83,6 +117,9 @@ class ROC:
 
     def get_roc_curve(self, num_points: int = 101):
         """(fpr, tpr) arrays at score thresholds (reference curves/RocCurve)."""
+        if self.threshold_steps > 0:
+            _, fpr, tpr = self._thresholded_rates()
+            return fpr[::-1], tpr[::-1]
         s, y = self._collect()
         thresholds = np.linspace(1.0, 0.0, num_points)
         pos = max((y > 0.5).sum(), 1)
@@ -90,6 +127,46 @@ class ROC:
         tpr = [(s[y > 0.5] >= t).sum() / pos for t in thresholds]
         fpr = [(s[y <= 0.5] >= t).sum() / neg for t in thresholds]
         return np.asarray(fpr), np.asarray(tpr)
+
+    def export_roc_curve(self, num_points: int = 101) -> "RocCurve":
+        """Exportable curve object (reference ROC.getRocCurve -> RocCurve)."""
+        from deeplearning4j_tpu.eval.curves import RocCurve
+        if self.threshold_steps > 0:
+            thresholds, fpr, tpr = self._thresholded_rates()
+            return RocCurve(thresholds=[float(t) for t in thresholds],
+                            fpr=[float(v) for v in fpr],
+                            tpr=[float(v) for v in tpr])
+        thresholds = np.linspace(1.0, 0.0, num_points)
+        fpr, tpr = self.get_roc_curve(num_points)
+        return RocCurve(thresholds=[float(t) for t in thresholds],
+                        fpr=[float(v) for v in fpr],
+                        tpr=[float(v) for v in tpr])
+
+    def export_precision_recall_curve(self, num_points: int = 101) -> "PrecisionRecallCurve":
+        """reference ROC.getPrecisionRecallCurve -> PrecisionRecallCurve."""
+        from deeplearning4j_tpu.eval.curves import PrecisionRecallCurve
+        if self.threshold_steps > 0:
+            thresholds = np.linspace(0.0, 1.0, self.threshold_steps + 1)
+            pos_ge = np.concatenate([np.cumsum(self._pos_hist[::-1])[::-1], [0]])
+            neg_ge = np.concatenate([np.cumsum(self._neg_hist[::-1])[::-1], [0]])
+            prec = pos_ge / np.maximum(pos_ge + neg_ge, 1)
+            rec = pos_ge / max(self._pos_hist.sum(), 1)
+            return PrecisionRecallCurve(
+                thresholds=[float(t) for t in thresholds],
+                precision=[float(v) for v in prec],
+                recall=[float(v) for v in rec])
+        s, y = self._collect()
+        thresholds = np.linspace(0.0, 1.0, num_points)
+        ypos = y > 0.5
+        npos = max(ypos.sum(), 1)
+        prec, rec = [], []
+        for t in thresholds:
+            sel = s >= t
+            tp = (ypos & sel).sum()
+            prec.append(float(tp / max(sel.sum(), 1)))
+            rec.append(float(tp / npos))
+        return PrecisionRecallCurve(thresholds=[float(t) for t in thresholds],
+                                    precision=prec, recall=rec)
 
 
 class ROCMultiClass:
@@ -114,6 +191,48 @@ class ROCMultiClass:
 
     def calculate_auc(self, cls: int) -> float:
         return self._rocs[cls].calculate_auc()
+
+    def calculate_average_auc(self) -> float:
+        vals = [r.calculate_auc() for r in self._rocs]
+        vals = [v for v in vals if not np.isnan(v)]
+        return float(np.mean(vals)) if vals else float("nan")
+
+
+class ROCBinary:
+    """Independent binary ROC per output column, for multi-label sigmoid
+    outputs (reference eval/ROCBinary.java:43). Differs from ROCMultiClass
+    in that columns are independent binary problems, not one-vs-all over a
+    softmax."""
+
+    def __init__(self, threshold_steps: int = 0):
+        self.threshold_steps = threshold_steps
+        self._rocs: Optional[List[ROC]] = None
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        preds = np.asarray(predictions)
+        n = labels.shape[-1]
+        if self._rocs is None:
+            self._rocs = [ROC(self.threshold_steps) for _ in range(n)]
+        elif n != len(self._rocs):
+            raise ValueError(
+                f"Batch has {n} outputs; previous batches had {len(self._rocs)}")
+        lab2 = labels.reshape(-1, n)
+        pr2 = preds.reshape(-1, n)
+        if mask is not None:
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            lab2, pr2 = lab2[m], pr2[m]
+        for i in range(n):
+            self._rocs[i].eval(lab2[:, i], pr2[:, i])
+
+    def num_outputs(self) -> int:
+        return 0 if self._rocs is None else len(self._rocs)
+
+    def calculate_auc(self, output: int) -> float:
+        return self._rocs[output].calculate_auc()
+
+    def calculate_auprc(self, output: int) -> float:
+        return self._rocs[output].calculate_auprc()
 
     def calculate_average_auc(self) -> float:
         vals = [r.calculate_auc() for r in self._rocs]

@@ -235,6 +235,10 @@ def import_keras_sequential_model_and_weights(
         enforce_training_config: bool = False) -> MultiLayerNetwork:
     """Import a Keras Sequential model (reference
     KerasModelImport.importKerasSequentialModelAndWeights :106-174)."""
+    if path is None and weights_path is None:
+        raise KerasImportError(
+            "Either a full-model .h5 path or weights_path must be provided "
+            "(got path=None, weights_path=None)")
     archive = Hdf5Archive(path) if path is not None else None
     warchive = archive
     if weights_path is not None:
@@ -377,6 +381,10 @@ def import_keras_model_and_weights(
         weights_path: Optional[str] = None) -> ComputationGraph:
     """Import a Keras functional model (reference
     KerasModelImport.importKerasModelAndWeights :50-104)."""
+    if path is None and weights_path is None:
+        raise KerasImportError(
+            "Either a full-model .h5 path or weights_path must be provided "
+            "(got path=None, weights_path=None)")
     archive = Hdf5Archive(path) if path is not None else None
     warchive = archive
     if weights_path is not None:

@@ -100,6 +100,60 @@ class TimeIterationListener(TrainingListener):
         log.info("Remaining time: %d min %d sec", int(remaining // 60), int(remaining % 60))
 
 
+class EvaluativeListener(TrainingListener):
+    """Periodically evaluate on a held-out iterator during training
+    (reference optimize/listeners/EvaluativeListener.java:61 — frequency +
+    InvocationType ITERATION_END / EPOCH_END, callback hook).
+
+    ``evaluations`` are zero-arg factories (e.g. ``Evaluation``) so each
+    invocation starts fresh; results are kept in ``history`` and passed to
+    ``callback(model, evals)`` if provided.
+    """
+
+    ITERATION_END = "iteration_end"
+    EPOCH_END = "epoch_end"
+
+    def __init__(self, iterator, frequency: int = 1,
+                 invocation_type: str = EPOCH_END,
+                 evaluations=None, callback=None):
+        self.iterator = iterator
+        self.frequency = max(1, frequency)
+        self.invocation_type = invocation_type
+        self.evaluations = evaluations or []
+        self.callback = callback
+        self.history: List[list] = []
+        self._count = 0
+
+    def _invoke(self, model):
+        self._count += 1
+        if self._count % self.frequency != 0:
+            return
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        if self.evaluations:
+            evals = [f() for f in self.evaluations]
+            for ds in self.iterator:
+                preds = model.output(ds.features)
+                for e in evals:
+                    e.eval(ds.labels, preds, mask=getattr(ds, "labels_mask", None))
+        else:
+            evals = [model.evaluate(self.iterator)]
+        self.history.append(evals)
+        for e in evals:
+            if hasattr(e, "accuracy"):
+                log.info("EvaluativeListener: accuracy %.4f", e.accuracy())
+        if self.callback is not None:
+            self.callback(model, evals)
+
+    def iteration_done(self, model, iteration, epoch):
+        if self.invocation_type == self.ITERATION_END:
+            self._invoke(model)
+
+    def on_epoch_end(self, model):
+        if self.invocation_type == self.EPOCH_END:
+            self._invoke(model)
+
+
 class SleepyTrainingListener(TrainingListener):
     """Debug throttling (reference SleepyTrainingListener.java)."""
 

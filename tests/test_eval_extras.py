@@ -1,0 +1,212 @@
+"""Eval-suite extensions: thresholded ROC, ROCBinary, top-N accuracy,
+EvaluationCalibration, exportable curves, EvaluativeListener, LR schedules.
+
+Reference parity: eval/ROC.java thresholded mode, ROCBinary.java,
+Evaluation.java topNAccuracy, EvaluationCalibration.java, eval/curves/*,
+optimize/listeners/EvaluativeListener.java, lr decay policies in
+NeuralNetConfiguration builder.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval import (
+    Evaluation, EvaluationCalibration, Histogram, PrecisionRecallCurve,
+    ReliabilityDiagram, ROC, ROCBinary, RocCurve,
+)
+from deeplearning4j_tpu.eval.curves import BaseCurve
+
+
+def _binary_data(n=2000, seed=0):
+    rng = np.random.default_rng(seed)
+    y = (rng.random(n) < 0.4).astype(np.float64)
+    # informative but noisy scores
+    s = np.clip(0.3 * y + 0.4 * rng.random(n), 0.0, 1.0)
+    return y, s
+
+
+def test_thresholded_roc_matches_exact():
+    y, s = _binary_data()
+    exact = ROC()
+    exact.eval(y, s)
+    binned = ROC(threshold_steps=200)
+    binned.eval(y[:1000], s[:1000])
+    binned.eval(y[1000:], s[1000:])  # multi-batch accumulation
+    assert binned.calculate_auc() == pytest.approx(exact.calculate_auc(), abs=0.02)
+    # thresholded mode must not retain raw arrays
+    assert not binned._scores and not binned._labels
+
+
+def test_thresholded_roc_curves_export():
+    y, s = _binary_data()
+    roc = ROC(threshold_steps=100)
+    roc.eval(y, s)
+    curve = roc.export_roc_curve()
+    assert isinstance(curve, RocCurve)
+    assert curve.calculate_auc() == pytest.approx(roc.calculate_auc(), abs=1e-6)
+    pr = roc.export_precision_recall_curve()
+    assert isinstance(pr, PrecisionRecallCurve)
+    assert 0.0 <= pr.calculate_auprc() <= 1.0
+    # json roundtrip (reference BaseCurve.toJson/fromJson)
+    back = BaseCurve.from_json(curve.to_json())
+    assert back == curve
+
+
+def test_roc_binary_per_output():
+    rng = np.random.default_rng(1)
+    n = 500
+    labels = (rng.random((n, 3)) < 0.5).astype(np.float64)
+    preds = labels.copy()
+    # column 0 perfectly predicted, column 1 pure noise, column 2 anti-predicted
+    preds[:, 1] = rng.random(n)
+    preds[:, 2] = 1.0 - labels[:, 2]
+    rb = ROCBinary()
+    rb.eval(labels, preds)
+    assert rb.num_outputs() == 3
+    assert rb.calculate_auc(0) == pytest.approx(1.0)
+    assert rb.calculate_auc(1) == pytest.approx(0.5, abs=0.1)
+    assert rb.calculate_auc(2) == pytest.approx(0.0)
+    assert 0.4 < rb.calculate_average_auc() < 0.7
+
+
+def test_top_n_accuracy():
+    # 4 classes; true class is always the 2nd-highest probability
+    labels = np.eye(4)[[0, 1, 2, 3]]
+    preds = np.full((4, 4), 0.1)
+    for i in range(4):
+        preds[i, (i + 1) % 4] = 0.5   # top-1 is wrong
+        preds[i, i] = 0.3             # true class is rank 2
+    ev = Evaluation(top_n=2)
+    ev.eval(labels, preds)
+    assert ev.accuracy() == 0.0
+    assert ev.top_n_accuracy() == 1.0
+    ev1 = Evaluation()
+    ev1.eval(labels, preds)
+    assert ev1.top_n_accuracy() == ev1.accuracy() == 0.0
+
+
+def test_top_n_respects_mask():
+    labels = np.eye(3)[[0, 1, 2]]
+    preds = np.eye(3)[[0, 1, 0]] * 0.8 + 0.05
+    ev = Evaluation(top_n=2)
+    ev.eval(labels, preds, mask=np.array([1, 1, 0]))
+    assert ev._top_n_total == 2
+    assert ev.top_n_accuracy() == 1.0
+
+
+def test_calibration_perfectly_calibrated():
+    rng = np.random.default_rng(2)
+    n = 20000
+    p = rng.random(n)
+    y = (rng.random(n) < p).astype(np.float64)
+    labels = np.stack([1 - y, y], -1)
+    preds = np.stack([1 - p, p], -1)
+    cal = EvaluationCalibration(reliability_bins=10, histogram_bins=20)
+    cal.eval(labels, preds)
+    ece = cal.expected_calibration_error(1)
+    assert ece < 0.03, f"perfectly calibrated data should have tiny ECE, got {ece}"
+    diag = cal.get_reliability_diagram(1)
+    assert isinstance(diag, ReliabilityDiagram)
+    mp = np.asarray(diag.mean_predicted_value)
+    fp = np.asarray(diag.fraction_positives)
+    assert np.all(np.abs(mp - fp) < 0.1)
+
+
+def test_calibration_overconfident_detected():
+    rng = np.random.default_rng(3)
+    n = 5000
+    y = (rng.random(n) < 0.5).astype(np.float64)
+    # always predicts 0.95 for class 1 regardless of truth -> badly calibrated
+    p = np.full(n, 0.95)
+    cal = EvaluationCalibration()
+    cal.eval(np.stack([1 - y, y], -1), np.stack([1 - p, p], -1))
+    assert cal.expected_calibration_error(1) > 0.3
+
+
+def test_calibration_histograms():
+    y, s = _binary_data()
+    cal = EvaluationCalibration(histogram_bins=10)
+    cal.eval(np.stack([1 - y, y], -1), np.stack([1 - s, s], -1))
+    h = cal.get_probability_histogram(1)
+    assert isinstance(h, Histogram)
+    assert sum(h.bin_counts) == len(y)
+    hp = cal.get_probability_histogram(1, positive_only=True)
+    assert sum(hp.bin_counts) == int(y.sum())
+    r = cal.get_residual_plot(1)
+    assert sum(r.bin_counts) == len(y)
+    assert len(h.bin_edges()) == 11
+
+
+# --------------------------------------------------------- EvaluativeListener
+
+def _iris_net(lr_policy=None, **lr_kwargs):
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12345)
+            .updater(Sgd(learning_rate=0.1, lr_policy=lr_policy, **lr_kwargs))
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_evaluative_listener_epoch_end():
+    from deeplearning4j_tpu.datasets.iterators import IrisDataSetIterator
+    from deeplearning4j_tpu.optimize.listeners import EvaluativeListener
+
+    it = IrisDataSetIterator(batch=150)
+    seen = []
+    lst = EvaluativeListener(it, frequency=2,
+                             invocation_type=EvaluativeListener.EPOCH_END,
+                             evaluations=[Evaluation],
+                             callback=lambda model, evals: seen.append(evals))
+    net = _iris_net()
+    net.set_listeners(lst)
+    net.fit(it, num_epochs=4)
+    # frequency=2 over 4 epochs -> 2 invocations
+    assert len(lst.history) == 2 and len(seen) == 2
+    assert isinstance(lst.history[-1][0], Evaluation)
+    assert lst.history[-1][0].accuracy() > 0.3
+
+
+# ------------------------------------------------------------- LR schedules
+
+def test_lr_schedule_trajectory():
+    """Step decay actually changes the applied update magnitude over
+    iterations (reference lr policy 'step': lr = base * rate^(floor(it/steps)))."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+
+    upd = Sgd(learning_rate=1.0, lr_policy="step", lr_decay_rate=0.5,
+              lr_policy_steps=2)
+    tx = upd.to_optax()
+    params = {"w": jnp.ones(())}
+    state = tx.init(params)
+    applied = []
+    for _ in range(6):
+        updates, state = tx.update({"w": jnp.ones(())}, state, params)
+        applied.append(float(-updates["w"]))
+    assert applied == pytest.approx([1.0, 1.0, 0.5, 0.5, 0.25, 0.25])
+
+
+def test_lr_schedule_map_policy():
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+
+    upd = Sgd(learning_rate=0.1, lr_policy="schedule",
+              lr_schedule={0: 0.1, 3: 0.01})
+    tx = upd.to_optax()
+    params = {"w": jnp.ones(())}
+    state = tx.init(params)
+    applied = []
+    for _ in range(5):
+        updates, state = tx.update({"w": jnp.ones(())}, state, params)
+        applied.append(round(float(-updates["w"]), 6))
+    assert applied == pytest.approx([0.1, 0.1, 0.1, 0.01, 0.01])
