@@ -70,8 +70,15 @@ class PrecisionRecallCurve(BaseCurve):
     def calculate_auprc(self) -> float:
         r = np.asarray(self.recall)
         p = np.asarray(self.precision)
-        order = np.argsort(r, kind="mergesort")
-        return float(np.trapezoid(p[order], r[order]))
+        # collapse duplicate recall values to their best precision before
+        # integrating: trapezoid over raw points is sensitive to which tie
+        # representative lands next to the adjacent recall level, and the PR
+        # staircase semantics (reference PrecisionRecallCurve) take the
+        # highest-precision operating point at each recall
+        uniq, inv = np.unique(r, return_inverse=True)
+        best = np.zeros(len(uniq))
+        np.maximum.at(best, inv, p)
+        return float(np.trapezoid(best, uniq))
 
 
 @_register

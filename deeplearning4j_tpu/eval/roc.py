@@ -103,6 +103,19 @@ class ROC:
         return float(u / (len(pos) * len(neg)))
 
     def calculate_auprc(self) -> float:
+        if self.threshold_steps > 0:
+            pos_ge = np.cumsum(self._pos_hist[::-1])[::-1]
+            neg_ge = np.cumsum(self._neg_hist[::-1])[::-1]
+            total = pos_ge + neg_ge
+            # precision=1 for thresholds above every score (nothing predicted
+            # positive) — same anchor convention as the exact path below
+            precision = np.where(total == 0, 1.0,
+                                 pos_ge / np.maximum(total, 1))
+            recall = pos_ge / max(self._pos_hist.sum(), 1)
+            # ascending recall, anchored at (recall=0, precision=1)
+            recall = np.concatenate([[0.0], recall[::-1]])
+            precision = np.concatenate([[1.0], precision[::-1]])
+            return float(np.trapezoid(precision, recall))
         s, y = self._collect()
         order = np.argsort(-s, kind="mergesort")
         y = y[order] > 0.5
@@ -149,7 +162,11 @@ class ROC:
             thresholds = np.linspace(0.0, 1.0, self.threshold_steps + 1)
             pos_ge = np.concatenate([np.cumsum(self._pos_hist[::-1])[::-1], [0]])
             neg_ge = np.concatenate([np.cumsum(self._neg_hist[::-1])[::-1], [0]])
-            prec = pos_ge / np.maximum(pos_ge + neg_ge, 1)
+            total = pos_ge + neg_ge
+            # precision=1 where nothing is predicted positive (reference
+            # PrecisionRecallCurve zero-recall anchor), keeping the exported
+            # curve's AUPRC consistent with calculate_auprc()
+            prec = np.where(total == 0, 1.0, pos_ge / np.maximum(total, 1))
             rec = pos_ge / max(self._pos_hist.sum(), 1)
             return PrecisionRecallCurve(
                 thresholds=[float(t) for t in thresholds],
@@ -163,7 +180,7 @@ class ROC:
         for t in thresholds:
             sel = s >= t
             tp = (ypos & sel).sum()
-            prec.append(float(tp / max(sel.sum(), 1)))
+            prec.append(float(tp / sel.sum()) if sel.sum() else 1.0)
             rec.append(float(tp / npos))
         return PrecisionRecallCurve(thresholds=[float(t) for t in thresholds],
                                     precision=prec, recall=rec)

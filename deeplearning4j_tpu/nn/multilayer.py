@@ -69,6 +69,7 @@ class MultiLayerNetwork:
         self._rng = None
         self._jit_cache = {}
         self._rnn_carries = None  # stateful rnnTimeStep carries
+        self._last_features = None  # last fit minibatch (listener sampling)
 
     # ------------------------------------------------------------------ init
     def init(self, seed: Optional[int] = None) -> "MultiLayerNetwork":
@@ -433,6 +434,7 @@ class MultiLayerNetwork:
             self.params, self.state, self.opt_state, k, x, y, fm, lm)
         self._score = loss
         self.last_batch_size = int(x.shape[0])
+        self._last_features = x  # for listeners that sample activations
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration, self.epoch)
         self.iteration += 1
@@ -459,6 +461,7 @@ class MultiLayerNetwork:
                 self.params, self.state, self.opt_state, carries, k, xs, ys, fs, ls)
             self._score = loss
             self.last_batch_size = int(x.shape[0])
+            self._last_features = xs
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration, self.epoch)
             self.iteration += 1

@@ -133,7 +133,9 @@ class EvaluativeListener(TrainingListener):
         if self.evaluations:
             evals = [f() for f in self.evaluations]
             for ds in self.iterator:
-                preds = model.output(ds.features)
+                preds = model.output(
+                    ds.features,
+                    features_mask=getattr(ds, "features_mask", None))
                 for e in evals:
                     e.eval(ds.labels, preds, mask=getattr(ds, "labels_mask", None))
         else:

@@ -1,0 +1,10 @@
+"""Training UI: stats collection + storage-backed dashboard server.
+
+Parity surface: reference ``deeplearning4j-ui-parent`` (ui-model stats
+listener + play server); see ``ui/stats.py`` and ``ui/server.py``.
+"""
+
+from deeplearning4j_tpu.ui.stats import StatsListener
+from deeplearning4j_tpu.ui.server import UIServer, dashboard_html
+
+__all__ = ["StatsListener", "UIServer", "dashboard_html"]

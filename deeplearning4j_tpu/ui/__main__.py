@@ -1,0 +1,29 @@
+"""``python -m deeplearning4j_tpu.ui --file stats.jsonl [--port 9000]``
+
+Serve the training dashboard over an existing stats file (reference
+``PlayUIServer.main`` CLI entry, PlayUIServer.java:51).
+"""
+
+import argparse
+import time
+
+from deeplearning4j_tpu.storage import FileStatsStorage
+from deeplearning4j_tpu.ui.server import UIServer
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="deeplearning4j-tpu training UI")
+    ap.add_argument("--file", required=True, help="JSON-lines stats file")
+    ap.add_argument("--port", type=int, default=9000)
+    args = ap.parse_args(argv)
+    server = UIServer.get_instance(args.port).attach(FileStatsStorage(args.file))
+    print(f"UI server at {server.address} (ctrl-c to stop)", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

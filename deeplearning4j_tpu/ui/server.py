@@ -1,0 +1,320 @@
+"""Training UI server: dashboards over a StatsStorage.
+
+Parity surface: reference
+``deeplearning4j-ui-parent/deeplearning4j-play/.../PlayUIServer.java:51``
+(UIServer.getInstance().attach(statsStorage) lifecycle),
+``module/train/TrainModule.java`` (overview / model routes).
+
+TPU-native design: the Play/Netty server + SBE decoding + separate JS bundles
+become a stdlib ``ThreadingHTTPServer`` serving one self-contained HTML page
+(inline CSS/JS/SVG, no external assets — the training hosts have no egress)
+plus JSON endpoints reading straight from the JSON-record storage.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from deeplearning4j_tpu.ui.stats import TYPE_ID
+
+_DASHBOARD_HTML = r"""<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>deeplearning4j-tpu training UI</title>
+<style>
+.viz-root {
+  color-scheme: light;
+  --surface-1: #fcfcfb; --surface-2: #f0efec;
+  --text-primary: #0b0b0b; --text-secondary: #52514e;
+  --series-1: #2a78d6; --series-2: #eb6834; --grid: #e3e2de;
+}
+@media (prefers-color-scheme: dark) {
+  :root:where(:not([data-theme="light"])) .viz-root {
+    color-scheme: dark;
+    --surface-1: #1a1a19; --surface-2: #383835;
+    --text-primary: #ffffff; --text-secondary: #c3c2b7;
+    --series-1: #3987e5; --series-2: #d95926; --grid: #32312f;
+  }
+}
+body { margin: 0; font: 14px/1.45 system-ui, sans-serif; }
+.viz-root { background: var(--surface-1); color: var(--text-primary);
+  min-height: 100vh; padding: 20px 28px; box-sizing: border-box; }
+h1 { font-size: 18px; font-weight: 600; margin: 0 0 4px; }
+h2 { font-size: 13px; font-weight: 600; margin: 0 0 8px;
+  color: var(--text-secondary); text-transform: uppercase;
+  letter-spacing: .04em; }
+.sub { color: var(--text-secondary); margin-bottom: 16px; }
+.controls { display: flex; gap: 12px; align-items: center;
+  margin-bottom: 18px; flex-wrap: wrap; }
+select { background: var(--surface-1); color: var(--text-primary);
+  border: 1px solid var(--grid); border-radius: 6px; padding: 4px 8px; }
+.tiles { display: flex; gap: 14px; flex-wrap: wrap; margin-bottom: 18px; }
+.tile { background: var(--surface-2); border-radius: 10px;
+  padding: 12px 18px; min-width: 130px; }
+.tile .v { font-size: 22px; font-weight: 650; font-variant-numeric: tabular-nums; }
+.tile .l { font-size: 12px; color: var(--text-secondary); }
+.grid2 { display: grid; grid-template-columns: repeat(auto-fit, minmax(420px, 1fr));
+  gap: 18px; }
+.card { background: var(--surface-1); border: 1px solid var(--grid);
+  border-radius: 10px; padding: 14px; }
+svg text { fill: var(--text-secondary); font: 11px system-ui, sans-serif; }
+svg .axis { stroke: var(--grid); stroke-width: 1; }
+svg .line1 { stroke: var(--series-1); stroke-width: 2; fill: none; }
+svg .line2 { stroke: var(--series-2); stroke-width: 2; fill: none; }
+svg .bar { fill: var(--series-1); }
+.tooltip { position: fixed; pointer-events: none; background: var(--surface-2);
+  color: var(--text-primary); border: 1px solid var(--grid); border-radius: 6px;
+  padding: 6px 9px; font-size: 12px; display: none; z-index: 10; }
+table.info { border-collapse: collapse; font-size: 13px; }
+table.info td { padding: 3px 14px 3px 0; vertical-align: top; }
+table.info td:first-child { color: var(--text-secondary); }
+</style></head>
+<body><div class="viz-root">
+<h1>deeplearning4j-tpu training UI</h1>
+<div class="sub" id="subtitle">loading…</div>
+<div class="controls">
+  <label>Session <select id="session"></select></label>
+  <label>Parameter <select id="param"></select></label>
+</div>
+<div class="tiles" id="tiles"></div>
+<div class="grid2">
+  <div class="card"><h2>Score vs iteration</h2><div id="score"></div></div>
+  <div class="card"><h2>Update : parameter ratio (log10, mean magnitude)</h2><div id="ratio"></div></div>
+  <div class="card"><h2>Parameter histogram (latest)</h2><div id="phist"></div></div>
+  <div class="card"><h2>Update histogram (latest)</h2><div id="uhist"></div></div>
+  <div class="card"><h2>Parameter mean &amp; stdev</h2><div id="pstats"></div></div>
+  <div class="card"><h2>Throughput (examples/sec)</h2><div id="perf"></div></div>
+  <div class="card"><h2>Memory</h2><div id="mem"></div></div>
+  <div class="card"><h2>Model / system</h2><div id="static"></div></div>
+</div>
+<div class="tooltip" id="tt"></div>
+</div>
+<script>
+"use strict";
+const W = 430, H = 190, PAD = {l: 52, r: 12, t: 10, b: 26};
+const $ = id => document.getElementById(id);
+function fmt(v) {
+  if (!isFinite(v)) return "—";
+  const a = Math.abs(v);
+  if (a >= 1e9) return (v/1e9).toFixed(2) + "G";
+  if (a >= 1e6) return (v/1e6).toFixed(2) + "M";
+  if (a >= 1e3) return (v/1e3).toFixed(1) + "k";
+  if (a >= 1 || a === 0) return v.toFixed(3).replace(/\.?0+$/, "");
+  return v.toExponential(2);
+}
+function scale(vals, lo, hi) {
+  let mn = Math.min(...vals), mx = Math.max(...vals);
+  if (!isFinite(mn) || !isFinite(mx)) { mn = 0; mx = 1; }
+  if (mn === mx) { mn -= 1; mx += 1; }
+  return v => lo + (v - mn) / (mx - mn) * (hi - lo);
+}
+function ticks(vals, n) {
+  let mn = Math.min(...vals), mx = Math.max(...vals);
+  if (!isFinite(mn) || !isFinite(mx) || mn === mx) return [mn];
+  const out = [];
+  for (let i = 0; i <= n; i++) out.push(mn + (mx - mn) * i / n);
+  return out;
+}
+// single-series line chart with crosshair tooltip; ys2 optional second series
+function lineChart(el, xs, ys, opts) {
+  opts = opts || {};
+  if (!xs.length) { el.innerHTML = "<div class='sub'>no data yet</div>"; return; }
+  const sx = scale(xs, PAD.l, W - PAD.r), sy = scale(ys, H - PAD.b, PAD.t);
+  let svg = `<svg viewBox="0 0 ${W} ${H}" width="100%">`;
+  for (const t of ticks(ys, 3)) {
+    const y = sy(t);
+    svg += `<line class="axis" x1="${PAD.l}" y1="${y}" x2="${W-PAD.r}" y2="${y}"/>`;
+    svg += `<text x="${PAD.l-6}" y="${y+3}" text-anchor="end">${fmt(t)}</text>`;
+  }
+  for (const t of ticks(xs, 4)) {
+    svg += `<text x="${sx(t)}" y="${H-8}" text-anchor="middle">${fmt(t)}</text>`;
+  }
+  const pts = xs.map((x, i) => `${sx(x).toFixed(1)},${sy(ys[i]).toFixed(1)}`);
+  svg += `<polyline class="line1" points="${pts.join(" ")}"/>`;
+  svg += `<line id="ch" stroke="var(--text-secondary)" stroke-dasharray="3,3" y1="${PAD.t}" y2="${H-PAD.b}" style="display:none"/>`;
+  svg += `</svg>`;
+  el.innerHTML = svg;
+  const node = el.querySelector("svg"), ch = el.querySelector("#ch"), tt = $("tt");
+  node.addEventListener("mousemove", ev => {
+    const r = node.getBoundingClientRect();
+    const px = (ev.clientX - r.left) / r.width * W;
+    let best = 0, bd = 1e18;
+    xs.forEach((x, i) => { const d = Math.abs(sx(x) - px); if (d < bd) { bd = d; best = i; } });
+    ch.setAttribute("x1", sx(xs[best])); ch.setAttribute("x2", sx(xs[best]));
+    ch.style.display = "";
+    tt.style.display = "block";
+    tt.style.left = (ev.clientX + 14) + "px"; tt.style.top = (ev.clientY + 10) + "px";
+    tt.textContent = `${opts.xlabel || "iter"} ${fmt(xs[best])} — ${fmt(ys[best])}${opts.unit || ""}`;
+  });
+  node.addEventListener("mouseleave", () => { ch.style.display = "none"; tt.style.display = "none"; });
+}
+// histogram bars: 4px-rounded data ends anchored to baseline, 2px surface gaps
+function histChart(el, hist) {
+  if (!hist || !hist.counts || !hist.counts.length) {
+    el.innerHTML = "<div class='sub'>no data yet</div>"; return;
+  }
+  const n = hist.counts.length, mx = Math.max(...hist.counts, 1);
+  const x0 = PAD.l, x1 = W - PAD.r, bw = (x1 - x0) / n;
+  let svg = `<svg viewBox="0 0 ${W} ${H}" width="100%">`;
+  svg += `<line class="axis" x1="${x0}" y1="${H-PAD.b}" x2="${x1}" y2="${H-PAD.b}"/>`;
+  hist.counts.forEach((c, i) => {
+    const h = c / mx * (H - PAD.t - PAD.b);
+    const y = H - PAD.b - h;
+    svg += `<path class="bar" d="M${(x0+i*bw+1).toFixed(1)} ${H-PAD.b} v${-Math.max(h-4,0)} q0,-4 4,-4 h${(bw-10).toFixed(1)} q4,0 4,4 v${Math.max(h-4,0)} z" data-i="${i}"><title>${fmt(hist.min + (hist.max-hist.min)*(i+0.5)/n)}: ${c}</title></path>`;
+  });
+  svg += `<text x="${x0}" y="${H-8}">${fmt(hist.min)}</text>`;
+  svg += `<text x="${x1}" y="${H-8}" text-anchor="end">${fmt(hist.max)}</text>`;
+  svg += `</svg>`;
+  el.innerHTML = svg;
+}
+async function j(url) { const r = await fetch(url); return r.json(); }
+let CUR = null;
+async function loadSessions() {
+  const sessions = await j("/api/sessions");
+  const sel = $("session");
+  sel.innerHTML = sessions.map(s => `<option>${s}</option>`).join("");
+  if (sessions.length) { CUR = sessions[sessions.length-1]; sel.value = CUR; await render(); }
+  else $("subtitle").textContent = "no sessions in storage";
+  sel.onchange = async () => { CUR = sel.value; await render(true); };
+  $("param").onchange = () => render();
+}
+function tile(label, value) {
+  return `<div class="tile"><div class="v">${value}</div><div class="l">${label}</div></div>`;
+}
+async function render(resetParam) {
+  const [stat, updates] = await Promise.all([
+    j(`/api/static?session=${encodeURIComponent(CUR)}`),
+    j(`/api/updates?session=${encodeURIComponent(CUR)}`)]);
+  const last = updates[updates.length-1] || {};
+  $("subtitle").textContent = stat && stat.model ?
+    `${stat.model.class} — ${fmt(stat.model.num_params)} params — ${stat.hardware.device_kind} ×${stat.hardware.device_count}` : CUR;
+  const pnames = last.parameters ? Object.keys(last.parameters) : [];
+  const psel = $("param");
+  if (resetParam !== false || psel.options.length !== pnames.length) {
+    const prev = psel.value;
+    psel.innerHTML = pnames.map(p => `<option>${p}</option>`).join("");
+    if (pnames.includes(prev)) psel.value = prev;
+  }
+  const P = psel.value || pnames[0];
+  const iters = updates.map(u => u.iteration);
+  const perf = last.performance || {};
+  $("tiles").innerHTML =
+    tile("last score", fmt(last.score)) +
+    tile("iteration", fmt(last.iteration ?? 0)) +
+    tile("examples/sec", fmt(perf.examples_per_second || 0)) +
+    tile("total examples", fmt(perf.total_examples || 0)) +
+    tile("runtime", fmt((perf.total_runtime_ms || 0)/1000) + "s");
+  lineChart($("score"), iters, updates.map(u => u.score ?? NaN));
+  lineChart($("ratio"), iters,
+    updates.map(u => u.update_ratios && u.update_ratios[P] > 0 ? Math.log10(u.update_ratios[P]) : NaN));
+  histChart($("phist"), last.parameters && last.parameters[P] && last.parameters[P].histogram);
+  histChart($("uhist"), last.updates && last.updates[P] && last.updates[P].histogram);
+  lineChart($("pstats"), iters,
+    updates.map(u => u.parameters && u.parameters[P] ? u.parameters[P].mean : NaN));
+  lineChart($("perf"), iters,
+    updates.map(u => (u.performance || {}).examples_per_second ?? NaN), {unit: " ex/s"});
+  lineChart($("mem"), iters,
+    updates.map(u => (u.memory || {}).host_rss_bytes ?? NaN), {unit: " B"});
+  if (stat) {
+    const sw = stat.software || {}, hw = stat.hardware || {};
+    $("static").innerHTML = `<table class="info">
+      <tr><td>backend</td><td>${sw.backend} (jax ${sw.jax}, python ${sw.python})</td></tr>
+      <tr><td>device</td><td>${hw.device_kind} ×${hw.device_count}</td></tr>
+      <tr><td>host</td><td>${sw.hostname}</td></tr>
+      <tr><td>worker</td><td>${stat.worker_id}</td></tr>
+      <tr><td>params</td><td>${stat.model ? Object.entries(stat.model.param_shapes).map(
+        ([k, s]) => `${k} [${s}]`).join("<br>") : ""}</td></tr></table>`;
+  }
+}
+loadSessions();
+setInterval(() => { if (CUR) render(false); }, 3000);
+</script></body></html>
+"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    storage = None  # set by UIServer
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _send(self, code, body: bytes, ctype: str):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, obj):
+        self._send(200, json.dumps(obj).encode(), "application/json")
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        session = q.get("session", [None])[0]
+        st = type(self).storage
+        if url.path in ("/", "/train", "/train/overview"):
+            self._send(200, _DASHBOARD_HTML.encode(), "text/html; charset=utf-8")
+        elif url.path == "/api/sessions":
+            self._json(st.list_session_ids() if st else [])
+        elif url.path == "/api/static":
+            self._json(st.get_static_info(session, TYPE_ID) if st else None)
+        elif url.path == "/api/updates":
+            self._json(st.get_all_updates(session, TYPE_ID) if st else [])
+        else:
+            self._send(404, b"not found", "text/plain")
+
+
+class UIServer:
+    """Singleton UI server (reference UIServer.getInstance() /
+    PlayUIServer.java:51). ``attach`` a storage, then browse
+    ``http://localhost:<port>/``."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000):
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.storage = None
+
+    @classmethod
+    def get_instance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = cls(port)
+        return cls._instance
+
+    def attach(self, storage):
+        self.storage = storage
+        handler = type("BoundHandler", (_Handler,), {"storage": storage})
+        if self._httpd is None:
+            self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
+            self.port = self._httpd.server_address[1]
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True)
+            self._thread.start()
+        else:
+            self._httpd.RequestHandlerClass = handler
+        return self
+
+    def detach(self):
+        self.storage = None
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if type(self)._instance is self:
+            type(self)._instance = None
+
+    @property
+    def address(self) -> str:
+        return f"http://localhost:{self.port}/"
+
+
+def dashboard_html() -> str:
+    """The dashboard page as a string (for tests / static export)."""
+    return _DASHBOARD_HTML

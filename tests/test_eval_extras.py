@@ -37,6 +37,33 @@ def test_thresholded_roc_matches_exact():
     assert not binned._scores and not binned._labels
 
 
+def test_thresholded_auprc_matches_exact():
+    y, s = _binary_data()
+    exact = ROC()
+    exact.eval(y, s)
+    binned = ROC(threshold_steps=500)
+    binned.eval(y, s)
+    assert binned.calculate_auprc() == pytest.approx(
+        exact.calculate_auprc(), abs=0.02)
+    # ROCBinary thresholded AUPRC goes through the same path
+    rb = ROCBinary(threshold_steps=100)
+    rb.eval(y.reshape(-1, 1), s.reshape(-1, 1))
+    assert np.isfinite(rb.calculate_auprc(0))
+
+
+def test_pr_curve_export_agrees_with_auprc():
+    # perfectly separable: AUPRC must be 1.0 through both paths, and the
+    # exported curve's own integration must agree with calculate_auprc
+    y = np.array([0, 1, 1, 0, 1], np.float64)
+    s = np.array([0.1, 0.9, 0.8, 0.3, 0.7])
+    for roc in (ROC(), ROC(threshold_steps=100)):
+        roc.eval(y, s)
+        assert roc.calculate_auprc() == pytest.approx(1.0, abs=0.02)
+        curve = roc.export_precision_recall_curve()
+        assert curve.calculate_auprc() == pytest.approx(
+            roc.calculate_auprc(), abs=0.05)
+
+
 def test_thresholded_roc_curves_export():
     y, s = _binary_data()
     roc = ROC(threshold_steps=100)

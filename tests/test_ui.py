@@ -1,0 +1,150 @@
+"""Observability stack tests: StatsListener → StatsStorage → UI server.
+
+Mirrors the reference's TestStatsListener.java / TestStatsStorage.java
+(deeplearning4j-ui-parent/deeplearning4j-ui-model/src/test) and the
+PlayUIServer attach lifecycle.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import (InputType, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.updaters import Sgd
+from deeplearning4j_tpu.storage import (FileStatsStorage, InMemoryStatsStorage,
+                                        StatsStorageEvent)
+from deeplearning4j_tpu.ui import StatsListener, UIServer, dashboard_html
+from deeplearning4j_tpu.ui.stats import TYPE_ID
+
+
+def small_net(seed=42):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(Sgd(learning_rate=0.1))
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def toy_data(n=30, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return DataSet(x, y)
+
+
+def train_with_listener(storage, iterations=4, **kw):
+    net = small_net()
+    listener = StatsListener(storage, session_id="sess-1", worker_id="w0", **kw)
+    net.set_listeners(listener)
+    ds = toy_data()
+    for _ in range(iterations):
+        net.fit(ds)
+    return net, listener
+
+
+def test_stats_listener_records():
+    storage = InMemoryStatsStorage()
+    train_with_listener(storage, iterations=4)
+    assert storage.list_session_ids() == ["sess-1"]
+    assert storage.list_type_ids("sess-1") == [TYPE_ID]
+    assert storage.list_worker_ids("sess-1") == ["w0"]
+    static = storage.get_static_info("sess-1", TYPE_ID)
+    assert static["model"]["class"] == "MultiLayerNetwork"
+    assert static["model"]["num_params"] > 0
+    assert "0_W" in static["model"]["param_shapes"]
+    updates = storage.get_all_updates("sess-1", TYPE_ID)
+    assert len(updates) == 4
+    last = updates[-1]
+    assert last["score"] is not None and np.isfinite(last["score"])
+    # per-param stats with histograms
+    p = last["parameters"]["0_W"]
+    assert set(p) >= {"mean", "stdev", "mean_magnitude", "histogram"}
+    assert sum(p["histogram"]["counts"]) == 4 * 8  # 4x8 weight matrix
+    # updates (param deltas) exist from the 2nd report on
+    assert "updates" in last and "0_W" in last["updates"]
+    assert last["update_ratios"]["0_W"] >= 0
+    # activations sampled via feed_forward on the stashed minibatch
+    assert "activations" in last and len(last["activations"]) == 2
+    # performance + memory
+    assert last["performance"]["total_examples"] == 4 * 30
+    assert last["memory"]["host_rss_bytes"] > 0
+    # records are JSON-serializable end to end
+    json.dumps(updates)
+
+
+def test_stats_listener_frequency():
+    storage = InMemoryStatsStorage()
+    train_with_listener(storage, iterations=6, frequency=2)
+    updates = storage.get_all_updates("sess-1", TYPE_ID)
+    assert [u["iteration"] for u in updates] == [0, 2, 4]
+    # aggregation across skipped iterations still counts every example seen
+    # up to the reporting iteration (report at iter 4 = 5 iterations seen)
+    assert updates[-1]["performance"]["total_examples"] == 5 * 30
+
+
+def test_storage_events_and_queries():
+    storage = InMemoryStatsStorage()
+    events = []
+    storage.register_storage_listener(lambda ev: events.append(ev.event_type))
+    train_with_listener(storage, iterations=2)
+    assert StatsStorageEvent.NEW_SESSION in events
+    assert events.count(StatsStorageEvent.POST_UPDATE) == 2
+    latest = storage.get_latest_update("sess-1", TYPE_ID)
+    assert latest["iteration"] == 1
+    after = storage.get_all_updates_after("sess-1", TYPE_ID,
+                                          latest["timestamp"] - 1e-4)
+    assert after and after[-1]["iteration"] == 1
+
+
+def test_file_stats_storage_roundtrip(tmp_path):
+    path = str(tmp_path / "stats.jsonl")
+    storage = FileStatsStorage(path)
+    train_with_listener(storage, iterations=3)
+    storage.close()
+    # reopen: all records reloaded
+    re = FileStatsStorage(path)
+    assert re.list_session_ids() == ["sess-1"]
+    assert re.num_update_records("sess-1", TYPE_ID) == 3
+    assert re.get_static_info("sess-1", TYPE_ID)["model"]["num_params"] > 0
+    re.close()
+
+
+def test_ui_server_endpoints():
+    storage = InMemoryStatsStorage()
+    train_with_listener(storage, iterations=2)
+    server = UIServer(port=0).attach(storage)
+    try:
+        base = f"http://localhost:{server.port}"
+        html = urllib.request.urlopen(f"{base}/").read().decode()
+        assert "deeplearning4j-tpu training UI" in html
+        assert "Score vs iteration" in html
+        sessions = json.loads(urllib.request.urlopen(
+            f"{base}/api/sessions").read())
+        assert sessions == ["sess-1"]
+        updates = json.loads(urllib.request.urlopen(
+            f"{base}/api/updates?session=sess-1").read())
+        assert len(updates) == 2 and updates[-1]["parameters"]
+        static = json.loads(urllib.request.urlopen(
+            f"{base}/api/static?session=sess-1").read())
+        assert static["model"]["class"] == "MultiLayerNetwork"
+        assert urllib.request.urlopen(f"{base}/api/sessions").status == 200
+    finally:
+        server.stop()
+
+
+def test_dashboard_html_self_contained():
+    html = dashboard_html()
+    # zero-egress rule: no external scripts/styles/fonts
+    assert "http://" not in html.replace("http://localhost", "")
+    assert "https://" not in html
+    assert "<script src" not in html and "link rel" not in html
