@@ -1,0 +1,240 @@
+"""Record readers + the record→DataSet bridge.
+
+Parity surface: DataVec's ``RecordReader`` SPI (datavec-api, external to the
+reference repo) and the reference's
+``deeplearning4j-core/.../datasets/datavec/RecordReaderDataSetIterator.java:51``
+(labelIndex / labelIndexFrom-To / regression modes) and
+``SequenceRecordReaderDataSetIterator.java`` (sequence + alignment modes).
+
+TPU-native design: records are plain Python lists of values; batch assembly
+produces contiguous numpy arrays once per minibatch (a single host->device
+transfer per step inside the jitted program). The Writable type hierarchy
+dissolves — numpy dtype promotion does the converter's job.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import os
+from typing import Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.fetchers import _one_hot as _one_hot_int
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+
+
+def _one_hot(idx: np.ndarray, n: int) -> np.ndarray:
+    return _one_hot_int(np.asarray(idx).astype(np.int64), n)
+
+
+class RecordReader:
+    """Iterable of records; a record is a list of values (DataVec
+    ``RecordReader.next()`` → List<Writable>)."""
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+class CollectionRecordReader(RecordReader):
+    """In-memory records (DataVec CollectionRecordReader)."""
+
+    def __init__(self, records: Sequence[Sequence]):
+        self.records = list(records)
+
+    def __iter__(self):
+        return iter(self.records)
+
+
+class CSVRecordReader(RecordReader):
+    """CSV file/string reader (DataVec CSVRecordReader): ``skip_lines``
+    header rows, custom delimiter, numeric fields parsed to float, other
+    fields kept as strings."""
+
+    def __init__(self, source: Union[str, Iterable[str]], skip_lines: int = 0,
+                 delimiter: str = ","):
+        self.source = source
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+
+    def _lines(self):
+        if isinstance(self.source, str):
+            if os.path.exists(self.source):
+                with open(self.source, "r", encoding="utf-8") as f:
+                    yield from f
+            else:
+                yield from io.StringIO(self.source)
+        else:
+            yield from self.source
+
+    def __iter__(self):
+        reader = csv.reader(self._lines(), delimiter=self.delimiter)
+        for i, row in enumerate(reader):
+            if i < self.skip_lines or not row:
+                continue
+            yield [self._parse(v) for v in row]
+
+    @staticmethod
+    def _parse(v: str):
+        v = v.strip()
+        try:
+            return float(v)
+        except ValueError:
+            return v
+
+
+class CSVSequenceRecordReader(RecordReader):
+    """Sequence CSV reader (DataVec CSVSequenceRecordReader): each source —
+    file path or list of lines — is one sequence; yields one list-of-records
+    per sequence."""
+
+    def __init__(self, sources: Sequence[Union[str, Sequence[str]]],
+                 skip_lines: int = 0, delimiter: str = ","):
+        self.sources = list(sources)
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+
+    def __iter__(self):
+        for src in self.sources:
+            yield list(CSVRecordReader(src, self.skip_lines, self.delimiter))
+
+
+class RecordReaderDataSetIterator(DataSetIterator):
+    """records → DataSet minibatches (reference
+    RecordReaderDataSetIterator.java:51).
+
+    - classification: ``label_index`` column holds the class id,
+      ``num_possible_labels`` sets one-hot width
+    - regression: ``regression=True`` with ``label_index`` (single target) or
+      ``label_index_from``/``label_index_to`` (inclusive range of targets)
+    - ``max_num_batches`` caps iteration (reference maxNumBatches)
+    """
+
+    def __init__(self, record_reader: RecordReader, batch_size: int,
+                 label_index: int = -1, num_possible_labels: int = -1,
+                 regression: bool = False,
+                 label_index_from: int = -1, label_index_to: int = -1,
+                 max_num_batches: int = -1):
+        self.reader = record_reader
+        self._batch = batch_size
+        self.label_index = label_index
+        self.num_possible_labels = num_possible_labels
+        self.regression = regression
+        self.label_index_from = label_index_from
+        self.label_index_to = label_index_to
+        self.max_num_batches = max_num_batches
+        if (not regression and label_index >= 0 and label_index_from < 0
+                and num_possible_labels <= 0):
+            # per-batch inference would give inconsistent one-hot widths
+            raise ValueError(
+                "Classification mode needs num_possible_labels (the one-hot "
+                "width must be fixed across minibatches)")
+
+    def reset(self):
+        self.reader.reset()
+
+    def batch_size(self):
+        return self._batch
+
+    def total_outcomes(self):
+        if self.regression:
+            if self.label_index_from >= 0:
+                return self.label_index_to - self.label_index_from + 1
+            return 1
+        return self.num_possible_labels if self.num_possible_labels > 0 else None
+
+    def _split(self, rows: List[list]):
+        arr = np.asarray(rows, np.float32)
+        if self.label_index_from >= 0:  # regression target range
+            lo, hi = self.label_index_from, self.label_index_to
+            labels = arr[:, lo:hi + 1]
+            feats = np.concatenate([arr[:, :lo], arr[:, hi + 1:]], axis=1)
+        elif self.label_index >= 0:
+            labels = arr[:, self.label_index:self.label_index + 1]
+            feats = np.concatenate(
+                [arr[:, :self.label_index], arr[:, self.label_index + 1:]],
+                axis=1)
+            if not self.regression:
+                labels = _one_hot(labels[:, 0], self.num_possible_labels)
+        else:  # no labels: features only (autoencoder style — labels=features)
+            feats = labels = arr
+        return DataSet(feats, labels.astype(np.float32))
+
+    def _generate(self):
+        rows, batches = [], 0
+        for rec in self.reader:
+            rows.append(rec)
+            if len(rows) == self._batch:
+                yield self._split(rows)
+                rows, batches = [], batches + 1
+                if 0 < self.max_num_batches <= batches:
+                    return
+        if rows:
+            yield self._split(rows)
+
+
+class SequenceRecordReaderDataSetIterator(DataSetIterator):
+    """sequence records → (batch, time, features) DataSets (reference
+    SequenceRecordReaderDataSetIterator.java). Sequences in a batch are
+    padded to the longest with features/labels masks (ALIGN_END of the
+    reference's alignment modes)."""
+
+    def __init__(self, reader: CSVSequenceRecordReader, batch_size: int,
+                 label_index: int = -1, num_possible_labels: int = -1,
+                 regression: bool = False):
+        self.reader = reader
+        self._batch = batch_size
+        self.label_index = label_index
+        self.num_possible_labels = num_possible_labels
+        self.regression = regression
+        if not regression and label_index >= 0 and num_possible_labels <= 0:
+            raise ValueError(
+                "Sequence classification mode needs num_possible_labels")
+
+    def reset(self):
+        self.reader.reset()
+
+    def batch_size(self):
+        return self._batch
+
+    def _assemble(self, seqs: List[np.ndarray]):
+        T = max(s.shape[0] for s in seqs)
+        li = self.label_index
+        n_feat = seqs[0].shape[1] - (1 if li >= 0 else 0)
+        n_lab = (self.num_possible_labels if not self.regression and li >= 0
+                 else (1 if li >= 0 else n_feat))
+        B = len(seqs)
+        x = np.zeros((B, T, n_feat), np.float32)
+        y = np.zeros((B, T, n_lab), np.float32)
+        mask = np.zeros((B, T), np.float32)
+        for i, s in enumerate(seqs):
+            t = s.shape[0]
+            mask[i, :t] = 1.0
+            if li >= 0:
+                feats = np.concatenate([s[:, :li], s[:, li + 1:]], axis=1)
+                lab = s[:, li]
+                x[i, :t] = feats
+                if self.regression:
+                    y[i, :t, 0] = lab
+                else:
+                    y[i, :t] = _one_hot(lab, n_lab)
+            else:
+                x[i, :t] = s
+                y[i, :t] = s
+        full = mask.all()
+        return DataSet(x, y, None if full else mask, None if full else mask)
+
+    def _generate(self):
+        seqs = []
+        for seq in self.reader:
+            seqs.append(np.asarray(seq, np.float32))
+            if len(seqs) == self._batch:
+                yield self._assemble(seqs)
+                seqs = []
+        if seqs:
+            yield self._assemble(seqs)

@@ -170,23 +170,42 @@ class FileStatsStorage(BaseStatsStorage):
     def __init__(self, path: str):
         super().__init__()
         self.path = path
+        self._read_offset = 0
         if os.path.exists(path):
-            with open(path, "r", encoding="utf-8") as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    record = json.loads(line)
-                    key = self._key(record)
-                    if record.get("kind") == "static":
-                        self._static[key] = record
-                    else:
-                        self._updates.setdefault(key, []).append(record)
+            self.refresh()
         self._fh = open(path, "a", encoding="utf-8")
 
+    def refresh(self) -> int:
+        """Ingest records appended to the file by another process since the
+        last read (the ``python -m deeplearning4j_tpu.ui`` live-tail path).
+        Returns the number of new records."""
+        if not os.path.exists(self.path):
+            return 0
+        n = 0
+        with self._lock, open(self.path, "r", encoding="utf-8") as f:
+            f.seek(self._read_offset)
+            for line in f:
+                if not line.endswith("\n"):
+                    break  # partial line mid-write; re-read next refresh
+                self._read_offset += len(line.encode("utf-8"))
+                line = line.strip()
+                if not line:
+                    continue
+                record = json.loads(line)
+                key = self._key(record)
+                if record.get("kind") == "static":
+                    self._static[key] = record
+                else:
+                    self._updates.setdefault(key, []).append(record)
+                n += 1
+        return n
+
     def _persist(self, record: dict):
-        self._fh.write(json.dumps(record) + "\n")
+        data = json.dumps(record) + "\n"
+        self._fh.write(data)
         self._fh.flush()
+        # our own writes need no re-ingest on refresh()
+        self._read_offset += len(data.encode("utf-8"))
 
     def close(self):
         self._fh.close()

@@ -255,6 +255,9 @@ class _Handler(BaseHTTPRequestHandler):
         q = parse_qs(url.query)
         session = q.get("session", [None])[0]
         st = type(self).storage
+        if st is not None and url.path.startswith("/api/"):
+            # live-tail: pick up records another process appended to the file
+            getattr(st, "refresh", lambda: 0)()
         if url.path in ("/", "/train", "/train/overview"):
             self._send(200, _DASHBOARD_HTML.encode(), "text/html; charset=utf-8")
         elif url.path == "/api/sessions":

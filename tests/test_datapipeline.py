@@ -1,0 +1,247 @@
+"""Data pipeline tests: record readers, CSV bridge, image iterators,
+MultiDataSet iterator family, normalizers.
+
+Mirrors the reference's RecordReaderDataSetiteratorTest.java,
+MultiDataSet iterator tests (deeplearning4j-nn/src/test/.../datasets/iterator)
+and ND4J normalizer tests.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (
+    AsyncMultiDataSetIterator, CifarDataSetIterator, CollectionRecordReader,
+    CSVRecordReader, CSVSequenceRecordReader, DataSet,
+    EarlyTerminationMultiDataSetIterator, EmnistDataSetIterator,
+    ImagePreProcessingScaler, IteratorDataSetIterator,
+    JointMultiDataSetIterator, LFWDataSetIterator, ListDataSetIterator,
+    ListMultiDataSetIterator, MultiDataSet, MultiDataSetIteratorAdapter,
+    MultiDataSetWrapperIterator, MultipleEpochsIterator,
+    NormalizerMinMaxScaler, NormalizerStandardize,
+    RecordReaderDataSetIterator, SamplingDataSetIterator,
+    SequenceRecordReaderDataSetIterator, SvhnDataSetIterator,
+    TinyImageNetDataSetIterator,
+)
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph import GraphBuilder, MergeVertex
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.optimize.updaters import Adam
+
+
+IRISH_CSV = "\n".join(
+    f"{5.0 + 0.1 * i},{3.0 + 0.05 * i},{1.5 + 0.2 * i},{0.2 + 0.1 * i},{i % 3}"
+    for i in range(30))
+
+
+def test_csv_record_reader_classification():
+    reader = CSVRecordReader(IRISH_CSV)
+    it = RecordReaderDataSetIterator(reader, batch_size=10, label_index=4,
+                                     num_possible_labels=3)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].features.shape == (10, 4)
+    assert batches[0].labels.shape == (10, 3)
+    # one-hot correctness: row i has class i%3
+    assert np.argmax(batches[0].labels[4]) == 4 % 3
+    # iterating again re-reads from the start (reset contract)
+    assert len(list(it)) == 3
+
+
+def test_csv_record_reader_regression_and_range():
+    reader = CSVRecordReader(IRISH_CSV)
+    it = RecordReaderDataSetIterator(reader, batch_size=30, label_index=4,
+                                     regression=True)
+    ds = next(iter(it))
+    assert ds.labels.shape == (30, 1)
+    assert ds.labels[7, 0] == 7 % 3
+    # label range: columns 2..3 as targets
+    it2 = RecordReaderDataSetIterator(CSVRecordReader(IRISH_CSV), 30,
+                                      regression=True,
+                                      label_index_from=2, label_index_to=3)
+    ds2 = next(iter(it2))
+    assert ds2.features.shape == (30, 3) and ds2.labels.shape == (30, 2)
+    assert it2.total_outcomes() == 2
+
+
+def test_csv_record_reader_skip_and_max_batches():
+    src = "h1,h2,h3\n" + "\n".join(f"{i},{i+1},{i % 2}" for i in range(20))
+    reader = CSVRecordReader(src, skip_lines=1)
+    it = RecordReaderDataSetIterator(reader, 5, label_index=2,
+                                     num_possible_labels=2, max_num_batches=2)
+    assert len(list(it)) == 2
+
+
+def test_collection_record_reader():
+    recs = [[0.0, 1.0, 0], [1.0, 0.0, 1], [0.5, 0.5, 0], [0.2, 0.9, 1]]
+    it = RecordReaderDataSetIterator(CollectionRecordReader(recs), 2,
+                                     label_index=2, num_possible_labels=2)
+    batches = list(it)
+    assert len(batches) == 2 and batches[0].features.shape == (2, 2)
+
+
+def test_sequence_record_reader_masks():
+    # two ragged sequences: 4 and 2 steps, 2 features + label column
+    seq1 = ["0.1,0.2,0", "0.3,0.4,1", "0.5,0.6,0", "0.7,0.8,1"]
+    seq2 = ["0.9,1.0,1", "1.1,1.2,0"]
+    reader = CSVSequenceRecordReader([seq1, seq2])
+    it = SequenceRecordReaderDataSetIterator(reader, batch_size=2,
+                                             label_index=2,
+                                             num_possible_labels=2)
+    ds = next(iter(it))
+    assert ds.features.shape == (2, 4, 2)
+    assert ds.labels.shape == (2, 4, 2)
+    assert ds.features_mask.tolist() == [[1, 1, 1, 1], [1, 1, 0, 0]]
+    # padded region zeroed
+    assert ds.features[1, 2:].sum() == 0
+
+
+def test_classification_requires_label_width():
+    with pytest.raises(ValueError, match="num_possible_labels"):
+        RecordReaderDataSetIterator(CSVRecordReader(IRISH_CSV), 10,
+                                    label_index=4)
+    with pytest.raises(ValueError, match="num_possible_labels"):
+        SequenceRecordReaderDataSetIterator(
+            CSVSequenceRecordReader([["1,2,0"]]), 2, label_index=2)
+
+
+def test_rebatch_preserves_masks():
+    x = np.zeros((7, 4, 2), np.float32)
+    y = np.zeros((7, 4, 2), np.float32)
+    m = np.zeros((7, 4), np.float32)
+    m[:, :2] = 1.0
+    src = ListDataSetIterator(DataSet(x, y, m, m), batch=3)
+    out = list(IteratorDataSetIterator(src, batch=5))
+    assert [b.num_examples() for b in out] == [5, 2]
+    assert out[0].features_mask.shape == (5, 4)
+    assert out[0].features_mask[:, :2].all() and not out[0].features_mask[:, 2:].any()
+
+
+def test_async_early_exit_releases_producer():
+    import threading
+    import time
+    before = threading.active_count()
+    base = ListMultiDataSetIterator(
+        MultiDataSet([np.zeros((64, 2), np.float32)],
+                     [np.zeros((64, 1), np.float32)]), batch=2)
+    for _ in range(5):
+        for i, _mds in enumerate(AsyncMultiDataSetIterator(base, queue_size=2)):
+            if i == 1:
+                break  # abandon mid-stream
+    # producers must terminate once the consumer walks away
+    for _ in range(50):
+        if threading.active_count() <= before:
+            break
+        time.sleep(0.05)
+    assert threading.active_count() <= before
+
+
+def test_image_iterators_shapes():
+    assert next(iter(CifarDataSetIterator(8, 16))).features.shape == (8, 32, 32, 3)
+    em = EmnistDataSetIterator("letters", 8, 16)
+    assert next(iter(em)).labels.shape == (8, 26)
+    assert EmnistDataSetIterator.num_labels("balanced") == 47
+    assert next(iter(SvhnDataSetIterator(4, 8))).features.shape == (4, 32, 32, 3)
+    assert next(iter(TinyImageNetDataSetIterator(4, 8))).labels.shape == (4, 200)
+    lfw = next(iter(LFWDataSetIterator(4, 8)))
+    assert lfw.features.shape[0] == 4 and lfw.features.shape[-1] == 3
+
+
+def test_iterator_rebatching_and_sampling():
+    src = ListDataSetIterator(
+        DataSet(np.arange(26, dtype=np.float32).reshape(13, 2),
+                np.ones((13, 1), np.float32)), batch=3)  # ragged 3s
+    out = list(IteratorDataSetIterator(src, batch=5))
+    assert [b.num_examples() for b in out] == [5, 5, 3]
+    # order preserved across rebatch
+    assert out[1].features[0, 0] == 10.0
+    samp = SamplingDataSetIterator(
+        DataSet(np.zeros((10, 2), np.float32), np.zeros((10, 1), np.float32)),
+        batch=4, num_samples=12)
+    assert [b.num_examples() for b in samp] == [4, 4, 4]
+    me = MultipleEpochsIterator(3, ListDataSetIterator(
+        DataSet(np.zeros((4, 2), np.float32), np.zeros((4, 1), np.float32)), 2))
+    assert len(list(me)) == 6
+
+
+def test_normalizers():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((100, 5)).astype(np.float32) * 3 + 7
+    ds = DataSet(x, np.zeros((100, 1), np.float32))
+    norm = NormalizerStandardize().fit(ds)
+    out = norm.pre_process(ds)
+    assert np.allclose(out.features.mean(0), 0, atol=1e-4)
+    assert np.allclose(out.features.std(0), 1, atol=1e-3)
+    assert np.allclose(norm.revert_features(out.features), x, atol=1e-3)
+    mm = NormalizerMinMaxScaler().fit(ds)
+    mo = mm.pre_process(ds)
+    assert mo.features.min() >= 0 and mo.features.max() <= 1.0001
+    img = ImagePreProcessingScaler().pre_process(
+        DataSet(np.full((2, 4, 4, 1), 255.0, np.float32),
+                np.zeros((2, 1), np.float32)))
+    assert img.features.max() == pytest.approx(1.0)
+
+
+def test_pre_processor_hook_on_iterator():
+    x = np.full((8, 3), 10.0, np.float32)
+    it = ListDataSetIterator(DataSet(x, np.zeros((8, 1), np.float32)), 4)
+    norm = NormalizerStandardize().fit(DataSet(x + np.random.default_rng(0)
+                                               .standard_normal((8, 3))
+                                               .astype(np.float32),
+                                               np.zeros((8, 1))))
+    it.set_pre_processor(norm)
+    for b in it:
+        assert b.features.shape == (4, 3)
+        assert abs(b.features.mean()) < 5  # scaled, not raw 10s
+
+
+def _two_input_graph():
+    return ComputationGraph(
+        (GraphBuilder()
+         .add_inputs("a", "b")
+         .add_layer("da", DenseLayer(n_out=8, activation="relu",
+                                     updater=Adam(0.01)), "a")
+         .add_layer("db", DenseLayer(n_out=8, activation="relu",
+                                     updater=Adam(0.01)), "b")
+         .add_vertex("m", MergeVertex(), "da", "db")
+         .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                       loss="mcxent", updater=Adam(0.01)), "m")
+         .set_outputs("out")
+         .set_input_types(InputType.feed_forward(3), InputType.feed_forward(5))
+         .build())).init()
+
+
+def test_joint_and_async_multidataset_cg_fit():
+    rng = np.random.default_rng(1)
+    n = 24
+    a = rng.standard_normal((n, 3)).astype(np.float32)
+    b = rng.standard_normal((n, 5)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)]
+    ita = ListDataSetIterator(DataSet(a, y), 8)
+    itb = ListDataSetIterator(DataSet(b, y), 8)
+    joint = JointMultiDataSetIterator(ita, itb, output_index=0)
+    mds = next(iter(joint))
+    assert len(mds.features) == 2 and len(mds.labels) == 1
+    # async prefetch over the joint stream feeding a ComputationGraph fit
+    net = _two_input_graph()
+    async_it = AsyncMultiDataSetIterator(joint, queue_size=2)
+    net.fit(async_it, num_epochs=2)
+    assert net.iteration == 6  # 3 batches x 2 epochs
+    assert np.isfinite(net.score())
+    # capped variant
+    capped = EarlyTerminationMultiDataSetIterator(joint, 2)
+    assert len(list(capped)) == 2
+
+
+def test_mds_adapters_roundtrip():
+    x = np.zeros((6, 4), np.float32)
+    y = np.zeros((6, 2), np.float32)
+    base = ListDataSetIterator(DataSet(x, y), 3)
+    mds_it = MultiDataSetIteratorAdapter(base)
+    out = list(mds_it)
+    assert len(out) == 2 and isinstance(out[0], MultiDataSet)
+    back = list(MultiDataSetWrapperIterator(ListMultiDataSetIterator(out)))
+    assert isinstance(back[0], DataSet) and back[0].features.shape == (3, 4)
+    # batching a single MultiDataSet
+    lm = ListMultiDataSetIterator(MultiDataSet([x], [y]), batch=4)
+    assert [m.num_examples() for m in lm] == [4, 2]

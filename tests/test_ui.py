@@ -119,6 +119,19 @@ def test_file_stats_storage_roundtrip(tmp_path):
     re.close()
 
 
+def test_file_storage_refresh_live_tail(tmp_path):
+    path = str(tmp_path / "s.jsonl")
+    reader = FileStatsStorage(path)  # opened before any data exists
+    writer = FileStatsStorage(path)  # simulates the training process
+    train_with_listener(writer, iterations=2)
+    assert reader.num_update_records("sess-1", TYPE_ID) == 0
+    assert reader.refresh() == 3  # static + 2 updates appended by writer
+    assert reader.num_update_records("sess-1", TYPE_ID) == 2
+    assert reader.refresh() == 0  # idempotent
+    writer.close()
+    reader.close()
+
+
 def test_ui_server_endpoints():
     storage = InMemoryStatsStorage()
     train_with_listener(storage, iterations=2)
