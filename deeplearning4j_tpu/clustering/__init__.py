@@ -1,0 +1,18 @@
+"""Clustering + nearest-neighbor structures.
+
+Parity surface: reference ``deeplearning4j-nearestneighbors-parent/``
+(nearestneighbor-core): ``clustering/vptree/VPTree.java:48``,
+``clustering/kdtree/KDTree.java:37``, ``clustering/kmeans/
+KMeansClustering.java:31`` (+ cluster/ClusterSet infrastructure).
+
+TPU-native split: tree *construction and traversal* are host-side (pointer
+chasing has no MXU mapping — same position they occupy in the reference), but
+K-Means Lloyd iterations run as one jitted XLA program per step where the
+distance matrix hits the MXU.
+"""
+
+from deeplearning4j_tpu.clustering.vptree import VPTree
+from deeplearning4j_tpu.clustering.kdtree import KDTree
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+
+__all__ = ["VPTree", "KDTree", "KMeansClustering"]

@@ -1,0 +1,113 @@
+"""Vantage-point tree for exact metric k-NN.
+
+Parity surface: reference
+``deeplearning4j-nearestneighbors-parent/nearestneighbor-core/src/main/java/
+org/deeplearning4j/clustering/vptree/VPTree.java:48`` (build + search with
+"euclidean" default distance, ``search(target, k, results, distances)``).
+
+Host-side numpy: median-split construction, best-first pruning search.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class _Node:
+    __slots__ = ("index", "radius", "inside", "outside", "bucket")
+
+    def __init__(self, index: int):
+        self.index = index
+        self.radius = 0.0
+        self.inside: Optional["_Node"] = None
+        self.outside: Optional["_Node"] = None
+        self.bucket: Optional[List[int]] = None  # leaf: tied/duplicate points
+
+
+class VPTree:
+    """Exact k-NN under a metric (default euclidean; "cosine" supported via
+    angular distance, which preserves the triangle inequality)."""
+
+    def __init__(self, items: np.ndarray, distance: str = "euclidean",
+                 seed: int = 123):
+        self.items = np.asarray(items, np.float64)
+        if distance not in ("euclidean", "cosine"):
+            raise ValueError(f"Unsupported distance {distance!r}")
+        self.distance = distance
+        if distance == "cosine":
+            norms = np.linalg.norm(self.items, axis=1, keepdims=True)
+            self._unit = self.items / np.maximum(norms, 1e-12)
+        rng = np.random.default_rng(seed)
+        self._root = self._build(list(range(len(self.items))), rng)
+
+    # ------------------------------------------------------------ distances
+    def _dist_many(self, idx: List[int], point: np.ndarray) -> np.ndarray:
+        if self.distance == "cosine":
+            p = point / max(np.linalg.norm(point), 1e-12)
+            cos = np.clip(self._unit[idx] @ p, -1.0, 1.0)
+            return np.arccos(cos)  # angular distance: a true metric
+        return np.linalg.norm(self.items[idx] - point, axis=1)
+
+    # ---------------------------------------------------------------- build
+    def _build(self, idx: List[int], rng) -> Optional[_Node]:
+        if not idx:
+            return None
+        vp_pos = int(rng.integers(0, len(idx)))
+        idx[0], idx[vp_pos] = idx[vp_pos], idx[0]
+        node = _Node(idx[0])
+        rest = idx[1:]
+        if not rest:
+            return node
+        d = self._dist_many(rest, self.items[node.index])
+        node.radius = float(np.median(d))
+        inside = [rest[i] for i in range(len(rest)) if d[i] < node.radius]
+        outside = [rest[i] for i in range(len(rest)) if d[i] >= node.radius]
+        if not inside and d.min() == d.max():
+            # all remaining points equidistant (e.g. duplicates): a median
+            # split cannot make progress — store them in a scanned leaf
+            # bucket instead of recursing once per point
+            node.bucket = outside
+            return node
+        node.inside = self._build(inside, rng)
+        node.outside = self._build(outside, rng)
+        return node
+
+    # --------------------------------------------------------------- search
+    def search(self, target, k: int) -> Tuple[List[int], List[float]]:
+        """k nearest item indices + distances, ascending (reference
+        VPTree.search)."""
+        target = np.asarray(target, np.float64)
+        heap: List[Tuple[float, int]] = []  # max-heap via negated distance
+        tau = [np.inf]
+
+        def offer(d: float, index: int):
+            if d < tau[0] or len(heap) < k:
+                if len(heap) == k:
+                    heapq.heappop(heap)
+                heapq.heappush(heap, (-d, index))
+                if len(heap) == k:
+                    tau[0] = -heap[0][0]
+
+        def visit(node: Optional[_Node]):
+            if node is None:
+                return
+            d = float(self._dist_many([node.index], target)[0])
+            offer(d, node.index)
+            if node.bucket is not None:
+                for bd, bi in zip(self._dist_many(node.bucket, target),
+                                  node.bucket):
+                    offer(float(bd), bi)
+                return
+            # best-first: descend the likelier side, prune with tau
+            near, far = ((node.inside, node.outside) if d < node.radius
+                         else (node.outside, node.inside))
+            visit(near)
+            if d - tau[0] <= node.radius <= d + tau[0] or len(heap) < k:
+                visit(far)
+
+        visit(self._root)
+        pairs = sorted((-nd, i) for nd, i in heap)
+        return [i for _, i in pairs], [d for d, _ in pairs]

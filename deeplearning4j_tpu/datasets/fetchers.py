@@ -16,11 +16,14 @@ archives; this environment is zero-egress, so:
 from __future__ import annotations
 
 import gzip
+import logging
 import os
 import struct
 from typing import Tuple
 
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 
 def _one_hot(y: np.ndarray, n: int) -> np.ndarray:
@@ -211,8 +214,9 @@ def svhn_data(num_examples: int = 10000, train: bool = True,
             y = m["y"].reshape(-1).astype(np.int64) % 10  # label "10" is digit 0
             n = min(num_examples, len(x))
             return x[:n], _one_hot(y[:n], 10)
-        except Exception:
-            pass
+        except Exception as e:
+            log.warning("SVHN cache at %s exists but failed to load (%s); "
+                        "falling back to synthetic data", path, e)
     return synthetic_images(num_examples, 32, 3, 10,
                             seed if train else seed + 1)
 
@@ -226,8 +230,9 @@ def tiny_imagenet_data(num_examples: int = 5000, train: bool = True,
     if os.path.isdir(base):
         try:
             return _load_tiny_imagenet_dir(base, num_examples, train)
-        except Exception:
-            pass
+        except Exception as e:
+            log.warning("TinyImageNet cache at %s exists but failed to load "
+                        "(%s); falling back to synthetic data", base, e)
     return synthetic_images(num_examples, 64, 3, 200,
                             seed if train else seed + 1)
 
@@ -265,7 +270,8 @@ def lfw_data(num_examples: int = 1000, train: bool = True, side: int = 40,
         x, y = (x[:cut], y[:cut]) if train else (x[cut:], y[cut:])
         n = min(num_examples, len(x))
         return x[:n], _one_hot(y[:n], int(d.target.max()) + 1)
-    except Exception:
-        pass
+    except Exception as e:
+        if not isinstance(e, ImportError) and "download_if_missing" not in str(e):
+            log.warning("LFW load failed (%s); falling back to synthetic", e)
     return synthetic_images(num_examples, side, 3, min(num_classes, 64),
                             seed if train else seed + 1)

@@ -389,14 +389,17 @@ class SamplingDataSetIterator(DataSetIterator):
         self._batch = batch
         self._num_samples = num_samples
         self._seed = seed
+        self._pass = 0  # distinct draws every epoch
 
     def batch_size(self):
         return self._batch
 
     def _generate(self):
-        rng = np.random.default_rng(self._seed)
+        rng = np.random.default_rng(self._seed + self._pass)
+        self._pass += 1
         n = self._source.num_examples()
-        for _ in range(max(1, self._num_samples // self._batch)):
+        # ceil: emit at least num_samples samples
+        for _ in range(-(-self._num_samples // self._batch)):
             idx = rng.integers(0, n, self._batch)
             yield DataSet(
                 self._source.features[idx], self._source.labels[idx],

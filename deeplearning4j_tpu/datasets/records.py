@@ -134,6 +134,7 @@ class RecordReaderDataSetIterator(DataSetIterator):
             raise ValueError(
                 "Classification mode needs num_possible_labels (the one-hot "
                 "width must be fixed across minibatches)")
+        self._label_map: dict = {}  # string label -> class index
 
     def reset(self):
         self.reader.reset()
@@ -148,8 +149,36 @@ class RecordReaderDataSetIterator(DataSetIterator):
             return 1
         return self.num_possible_labels if self.num_possible_labels > 0 else None
 
+    def _to_float(self, rows, what: str) -> np.ndarray:
+        try:
+            return np.asarray(rows, np.float32)
+        except (ValueError, TypeError):
+            bad = next(v for row in rows
+                       for v in (row if isinstance(row, (list, tuple)) else [row])
+                       if isinstance(v, str))
+            raise ValueError(
+                f"Non-numeric value {bad!r} in {what}; map string fields to "
+                "numbers before batching (string class labels in the label "
+                "column are mapped automatically)") from None
+
     def _split(self, rows: List[list]):
-        arr = np.asarray(rows, np.float32)
+        li = self.label_index
+        if (not self.regression and li >= 0 and rows
+                and isinstance(rows[0][li], str)):
+            # auto-map string class labels to stable indices in order of
+            # first appearance (the common 'species name' CSV case)
+            rows = [list(r) for r in rows]
+            for r in rows:
+                label = r[li]
+                if label not in self._label_map:
+                    if len(self._label_map) >= self.num_possible_labels:
+                        raise ValueError(
+                            f"More than num_possible_labels="
+                            f"{self.num_possible_labels} distinct labels "
+                            f"(new: {label!r})")
+                    self._label_map[label] = len(self._label_map)
+                r[li] = self._label_map[label]
+        arr = self._to_float(rows, "record batch")
         if self.label_index_from >= 0:  # regression target range
             lo, hi = self.label_index_from, self.label_index_to
             labels = arr[:, lo:hi + 1]

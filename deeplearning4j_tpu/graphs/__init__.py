@@ -1,0 +1,11 @@
+"""Graph vertex embeddings.
+
+Parity surface: reference ``deeplearning4j-graph/`` —
+``graph/Graph.java`` (adjacency-list IGraph), ``iterator/RandomWalkIterator.java``,
+``models/deepwalk/DeepWalk.java:31`` (+ GraphVectors lookup API).
+"""
+
+from deeplearning4j_tpu.graphs.graph import Graph
+from deeplearning4j_tpu.graphs.deepwalk import DeepWalk, RandomWalkIterator
+
+__all__ = ["Graph", "DeepWalk", "RandomWalkIterator"]

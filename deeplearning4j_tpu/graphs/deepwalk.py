@@ -1,0 +1,106 @@
+"""DeepWalk vertex embeddings.
+
+Parity surface: reference
+``deeplearning4j-graph/.../models/deepwalk/DeepWalk.java:31`` (builder:
+vectorSize, windowSize, learningRate; fit(IGraph, walkLength) with uniform
+random walks; GraphVectors API: getVertexVector, similarity) and
+``iterator/RandomWalkIterator.java`` (NO_EDGE_HANDLING=SELF_LOOP_ON_DISCONNECTED).
+
+TPU-native design: instead of the reference's per-pair hierarchical-softmax
+GraphHuffman SGD on the host, walks are lowered to token sequences and
+trained with the existing jitted SequenceVectors kernels (SGNS/HS on-device,
+batched scatter updates) — one engine for word, document and graph
+embeddings.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.graphs.graph import Graph
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+
+
+class RandomWalkIterator:
+    """Uniform random walks, one starting at every vertex per epoch
+    (reference RandomWalkIterator.java); disconnected vertices self-loop."""
+
+    def __init__(self, graph: Graph, walk_length: int, seed: int = 123,
+                 weighted: bool = False):
+        self.graph = graph
+        self.walk_length = walk_length
+        self.seed = seed
+        self.weighted = weighted
+
+    def walks(self, epoch: int = 0) -> List[List[int]]:
+        rng = np.random.default_rng(self.seed + epoch)
+        order = rng.permutation(self.graph.num_vertices)
+        out = []
+        for start in order:
+            v = int(start)
+            walk = [v]
+            for _ in range(self.walk_length - 1):
+                nbrs = self.graph.connected_vertices(v)
+                if not nbrs:
+                    walk.append(v)  # SELF_LOOP_ON_DISCONNECTED
+                    continue
+                if self.weighted:
+                    w = np.asarray(self.graph.edge_weights(v), np.float64)
+                    v = int(rng.choice(nbrs, p=w / w.sum()))
+                else:
+                    v = int(nbrs[rng.integers(0, len(nbrs))])
+                walk.append(v)
+            out.append(walk)
+        return out
+
+
+class DeepWalk:
+    """Vertex embeddings from truncated random walks + skip-gram."""
+
+    def __init__(self, vector_size: int = 100, window_size: int = 5,
+                 learning_rate: float = 0.025, walk_length: int = 40,
+                 walks_per_vertex: int = 1, negative: int = 5,
+                 epochs: int = 1, batch_size: int = 2048, seed: int = 123):
+        self.vector_size = vector_size
+        self.window_size = window_size
+        self.learning_rate = learning_rate
+        self.walk_length = walk_length
+        self.walks_per_vertex = walks_per_vertex
+        self.negative = negative
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        self._vectors: Optional[SequenceVectors] = None
+        self.num_vertices = 0
+
+    def fit(self, graph: Graph, walk_length: Optional[int] = None) -> "DeepWalk":
+        """Generate walks and train (reference DeepWalk.fit(IGraph, int))."""
+        L = walk_length or self.walk_length
+        it = RandomWalkIterator(graph, L, seed=self.seed)
+        sequences: List[List[str]] = []
+        for rep in range(self.walks_per_vertex):
+            sequences.extend([[str(v) for v in walk] for walk in it.walks(rep)])
+        self._vectors = SequenceVectors(
+            layer_size=self.vector_size, window_size=self.window_size,
+            learning_rate=self.learning_rate, negative=self.negative,
+            epochs=self.epochs, batch_size=self.batch_size,
+            min_word_frequency=1, sampling=0.0, seed=self.seed)
+        self._vectors.fit(sequences)
+        self.num_vertices = graph.num_vertices
+        return self
+
+    # ------------------------------------------------- GraphVectors surface
+    def get_vertex_vector(self, vertex: int) -> np.ndarray:
+        vec = self._vectors.word_vector(str(vertex))
+        if vec is None:
+            raise ValueError(f"Vertex {vertex} not in the trained model")
+        return vec
+
+    def similarity(self, a: int, b: int) -> float:
+        return self._vectors.similarity(str(a), str(b))
+
+    def verts_nearest(self, vertex: int, top_n: int = 10) -> List[int]:
+        return [int(w) for w in
+                self._vectors.words_nearest(str(vertex), top_n)]

@@ -1,0 +1,8 @@
+"""Dimensionality-reduction / visualization algorithms.
+
+Parity surface: reference ``deeplearning4j-core/.../plot/BarnesHutTsne.java``.
+"""
+
+from deeplearning4j_tpu.plot.tsne import BarnesHutTsne, Tsne
+
+__all__ = ["BarnesHutTsne", "Tsne"]

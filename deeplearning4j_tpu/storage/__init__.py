@@ -173,7 +173,9 @@ class FileStatsStorage(BaseStatsStorage):
         self._read_offset = 0
         if os.path.exists(path):
             self.refresh()
-        self._fh = open(path, "a", encoding="utf-8")
+        # newline="" disables platform newline translation so byte offsets
+        # tracked by refresh() stay exact everywhere
+        self._fh = open(path, "a", encoding="utf-8", newline="")
 
     def refresh(self) -> int:
         """Ingest records appended to the file by another process since the
@@ -182,13 +184,13 @@ class FileStatsStorage(BaseStatsStorage):
         if not os.path.exists(self.path):
             return 0
         n = 0
-        with self._lock, open(self.path, "r", encoding="utf-8") as f:
+        with self._lock, open(self.path, "rb") as f:  # binary: exact offsets
             f.seek(self._read_offset)
-            for line in f:
-                if not line.endswith("\n"):
+            for raw in f:
+                if not raw.endswith(b"\n"):
                     break  # partial line mid-write; re-read next refresh
-                self._read_offset += len(line.encode("utf-8"))
-                line = line.strip()
+                self._read_offset += len(raw)
+                line = raw.decode("utf-8").strip()
                 if not line:
                     continue
                 record = json.loads(line)

@@ -72,6 +72,32 @@ def test_csv_record_reader_skip_and_max_batches():
     assert len(list(it)) == 2
 
 
+def test_string_labels_mapped_and_string_features_rejected():
+    csv = "\n".join(f"1.0,2.0,{name}" for name in
+                    ["setosa", "versicolor", "setosa", "virginica"])
+    it = RecordReaderDataSetIterator(CSVRecordReader(csv), 4, label_index=2,
+                                     num_possible_labels=3)
+    ds = next(iter(it))
+    assert ds.labels.shape == (4, 3)
+    # first-appearance order: setosa=0, versicolor=1, virginica=2
+    assert np.argmax(ds.labels, 1).tolist() == [0, 1, 0, 2]
+    # string FEATURE columns fail with a clear message
+    bad = RecordReaderDataSetIterator(CSVRecordReader("a,1.0,0\nb,2.0,1"), 2,
+                                      label_index=2, num_possible_labels=2)
+    with pytest.raises(ValueError, match="Non-numeric"):
+        next(iter(bad))
+
+
+def test_sampling_iterator_distinct_epochs():
+    ds = DataSet(np.arange(40, dtype=np.float32).reshape(20, 2),
+                 np.zeros((20, 1), np.float32))
+    it = SamplingDataSetIterator(ds, batch=4, num_samples=10, seed=9)
+    e1 = np.concatenate([b.features for b in it])
+    e2 = np.concatenate([b.features for b in it])
+    assert len(e1) == 12  # ceil(10/4) * 4: at least num_samples emitted
+    assert not np.array_equal(e1, e2)  # re-draws each epoch
+
+
 def test_collection_record_reader():
     recs = [[0.0, 1.0, 0], [1.0, 0.0, 1], [0.5, 0.5, 0], [0.2, 0.9, 1]]
     it = RecordReaderDataSetIterator(CollectionRecordReader(recs), 2,

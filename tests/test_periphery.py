@@ -1,0 +1,165 @@
+"""Periphery capability tests: t-SNE, VPTree/KDTree/KMeans, DeepWalk.
+
+Mirrors the reference's BarnesHutTsneTest.java, VPTreeTest /
+KDTreeTest (nearestneighbor-core/src/test), KMeansTest, and
+deeplearning4j-graph's DeepWalkGradientCheck / TestDeepWalk.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import KDTree, KMeansClustering, VPTree
+from deeplearning4j_tpu.graphs import DeepWalk, Graph, RandomWalkIterator
+from deeplearning4j_tpu.plot import BarnesHutTsne
+
+
+def _blobs(n_per=40, centers=((0, 0, 0), (8, 8, 8), (-8, 8, -8)), seed=0):
+    rng = np.random.default_rng(seed)
+    xs, ys = [], []
+    for k, c in enumerate(centers):
+        xs.append(rng.standard_normal((n_per, len(c))) + np.asarray(c))
+        ys.append(np.full(n_per, k))
+    return np.concatenate(xs), np.concatenate(ys)
+
+
+# ------------------------------------------------------------------ trees
+def _brute_knn(items, target, k):
+    d = np.linalg.norm(items - target, axis=1)
+    idx = np.argsort(d, kind="mergesort")[:k]
+    return idx, d[idx]
+
+
+def test_vptree_matches_brute_force():
+    rng = np.random.default_rng(1)
+    items = rng.standard_normal((300, 6))
+    tree = VPTree(items)
+    for _ in range(10):
+        q = rng.standard_normal(6)
+        got_idx, got_d = tree.search(q, 7)
+        want_idx, want_d = _brute_knn(items, q, 7)
+        assert np.allclose(got_d, want_d)
+        assert set(got_idx) == set(want_idx)
+
+
+def test_vptree_cosine():
+    rng = np.random.default_rng(2)
+    items = rng.standard_normal((200, 5))
+    tree = VPTree(items, distance="cosine")
+    q = rng.standard_normal(5)
+    got_idx, _ = tree.search(q, 5)
+    cos = (items @ q) / (np.linalg.norm(items, axis=1) * np.linalg.norm(q))
+    want = set(np.argsort(-cos)[:5])
+    assert set(got_idx) == want
+
+
+def test_vptree_duplicate_points():
+    # degenerate input: many identical rows must not blow the recursion and
+    # must still answer exact k-NN
+    items = np.zeros((1500, 4))
+    items[:5] = np.arange(20).reshape(5, 4)
+    tree = VPTree(items)
+    idx, d = tree.search(np.zeros(4), 3)
+    assert d[0] == pytest.approx(0.0)
+    assert len(idx) == 3
+
+
+def test_kdtree_matches_brute_force():
+    rng = np.random.default_rng(3)
+    items = rng.standard_normal((250, 4))
+    tree = KDTree(items)
+    for _ in range(10):
+        q = rng.standard_normal(4)
+        got_idx, got_d = tree.search(q, 5)
+        want_idx, want_d = _brute_knn(items, q, 5)
+        assert np.allclose(got_d, want_d)
+        assert set(got_idx) == set(want_idx)
+    nn_idx, nn_d = tree.nn(items[17])
+    assert nn_idx == 17 and nn_d == pytest.approx(0.0)
+
+
+# ----------------------------------------------------------------- kmeans
+def test_kmeans_recovers_blobs():
+    x, y = _blobs()
+    km = KMeansClustering.setup(3, max_iterations=50)
+    assign, centroids = km.apply_to(x)
+    assert centroids.shape == (3, 3)
+    # each true blob maps to exactly one cluster id
+    mapping = [np.bincount(assign[y == k], minlength=3).argmax()
+               for k in range(3)]
+    assert len(set(mapping)) == 3
+    purity = np.mean([np.mean(assign[y == k] == mapping[k]) for k in range(3)])
+    assert purity > 0.95
+    assert np.isfinite(km.cost)
+
+
+# ------------------------------------------------------------------- tsne
+def test_tsne_kl_decreases_and_separates():
+    x, y = _blobs(n_per=30)
+    tsne = BarnesHutTsne(num_dimensions=2, perplexity=10.0, max_iter=300,
+                         learning_rate=100.0, stop_lying_iteration=100,
+                         seed=7)
+    emb = tsne.fit_transform(x)
+    assert emb.shape == (90, 2)
+    assert np.all(np.isfinite(emb))
+    # KL after early exaggeration ends must decrease
+    assert tsne.kl_history[-1] < tsne.kl_history[2]
+    # same-cluster points closer than cross-cluster on average
+    centroids = np.stack([emb[y == k].mean(0) for k in range(3)])
+    within = np.mean([np.linalg.norm(emb[y == k] - centroids[k], axis=1).mean()
+                      for k in range(3)])
+    between = np.mean([np.linalg.norm(centroids[i] - centroids[j])
+                       for i in range(3) for j in range(i + 1, 3)])
+    assert between > 2 * within
+
+
+def test_tsne_perplexity_validation():
+    with pytest.raises(ValueError, match="[Pp]erplexity"):
+        BarnesHutTsne(perplexity=30.0).fit(np.zeros((10, 3)))
+
+
+# --------------------------------------------------------------- deepwalk
+def _two_cliques(k=6):
+    g = Graph(2 * k)
+    for i in range(k):
+        for j in range(i + 1, k):
+            g.add_edge(i, j)
+            g.add_edge(k + i, k + j)
+    g.add_edge(0, k)  # single bridge
+    return g
+
+
+def test_random_walks():
+    g = _two_cliques()
+    walks = RandomWalkIterator(g, walk_length=10, seed=5).walks()
+    assert len(walks) == g.num_vertices
+    assert all(len(w) == 10 for w in walks)
+    # every step is along an edge (or self-loop on disconnected)
+    for w in walks:
+        for a, b in zip(w, w[1:]):
+            assert b in g.connected_vertices(a) or a == b
+    # disconnected vertex self-loops
+    g2 = Graph(3)
+    g2.add_edge(0, 1)
+    w2 = RandomWalkIterator(g2, 5, seed=1).walks()
+    lone = [w for w in w2 if w[0] == 2][0]
+    assert lone == [2, 2, 2, 2, 2]
+
+
+def test_deepwalk_embeds_cliques():
+    # two DISCONNECTED cliques: zero cross co-occurrence, so clique
+    # membership must dominate both similarity and neighbor ranking
+    k = 6
+    g = Graph(2 * k)
+    for i in range(k):
+        for j in range(i + 1, k):
+            g.add_edge(i, j)
+            g.add_edge(k + i, k + j)
+    dw = DeepWalk(vector_size=16, window_size=4, walk_length=20,
+                  walks_per_vertex=8, epochs=20, learning_rate=0.3, seed=3)
+    dw.fit(g)
+    assert dw.get_vertex_vector(0).shape == (16,)
+    intra = np.mean([dw.similarity(1, j) for j in range(2, 6)])
+    inter = np.mean([dw.similarity(1, j) for j in range(6, 12)])
+    assert intra > inter
+    near = dw.verts_nearest(2, top_n=4)
+    assert set(near) <= set(range(6))  # all neighbors from the same clique
