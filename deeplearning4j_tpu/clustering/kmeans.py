@@ -64,8 +64,13 @@ class KMeansClustering:
         chosen = [first]
         d2 = np.sum((x - x[first]) ** 2, axis=1)
         for _ in range(1, self.k):
-            probs = d2 / max(d2.sum(), 1e-12)
-            nxt = int(rng.choice(len(x), p=probs))
+            total = d2.sum()
+            if total <= 1e-12:
+                # fewer distinct points than k: every point already coincides
+                # with a chosen seed — fall back to uniform draws
+                nxt = int(rng.integers(0, len(x)))
+            else:
+                nxt = int(rng.choice(len(x), p=d2 / total))
             chosen.append(nxt)
             d2 = np.minimum(d2, np.sum((x - x[nxt]) ** 2, axis=1))
         return x[chosen].copy()
@@ -79,11 +84,13 @@ class KMeansClustering:
             raise ValueError("K-Means input contains non-finite values")
         x = jnp.asarray(x32)
         centroids = jnp.asarray(self._seed_centroids(x32))
-        assign = cost = None
         for _ in range(self.max_iterations):
-            centroids, assign, shift, cost = _lloyd_step(x, centroids, self.k)
+            centroids, _, shift, _ = _lloyd_step(x, centroids, self.k)
             if float(shift) < self.tol:
                 break
+        # final assignment pass against the FINAL centroids so the returned
+        # (assign, centroids, cost) triple is mutually consistent
+        _, assign, _, cost = _lloyd_step(x, centroids, self.k)
         self.centroids = np.asarray(centroids)
         self.cost = float(cost)
         return np.asarray(assign), self.centroids

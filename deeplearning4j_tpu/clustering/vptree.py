@@ -52,28 +52,45 @@ class VPTree:
         return np.linalg.norm(self.items[idx] - point, axis=1)
 
     # ---------------------------------------------------------------- build
-    def _build(self, idx: List[int], rng) -> Optional[_Node]:
-        if not idx:
-            return None
-        vp_pos = int(rng.integers(0, len(idx)))
-        idx[0], idx[vp_pos] = idx[vp_pos], idx[0]
-        node = _Node(idx[0])
-        rest = idx[1:]
+    def _make_node(self, work: List[int], rng):
+        """Pick a vantage point, median-split the rest. Returns
+        (node, inside, outside); inside=None marks a finished leaf/bucket."""
+        vp_pos = int(rng.integers(0, len(work)))
+        work[0], work[vp_pos] = work[vp_pos], work[0]
+        node = _Node(work[0])
+        rest = work[1:]
         if not rest:
-            return node
+            return node, None, None
         d = self._dist_many(rest, self.items[node.index])
         node.radius = float(np.median(d))
         inside = [rest[i] for i in range(len(rest)) if d[i] < node.radius]
         outside = [rest[i] for i in range(len(rest)) if d[i] >= node.radius]
-        if not inside and d.min() == d.max():
-            # all remaining points equidistant (e.g. duplicates): a median
-            # split cannot make progress — store them in a scanned leaf
-            # bucket instead of recursing once per point
+        if not inside:
+            # median split made no progress (ties/duplicates dominate):
+            # store the remainder in a scanned leaf bucket
             node.bucket = outside
-            return node
-        node.inside = self._build(inside, rng)
-        node.outside = self._build(outside, rng)
-        return node
+            return node, None, None
+        return node, inside, outside
+
+    def _build(self, idx: List[int], rng) -> Optional[_Node]:
+        """Iterative construction (explicit work stack): never touches the
+        Python recursion limit, even for duplicate-heavy inputs whose splits
+        shed O(1) points per level."""
+        if not idx:
+            return None
+        root, ins, outs = self._make_node(list(idx), rng)
+        stack = [] if ins is None else [(ins, root, "inside"),
+                                        (outs, root, "outside")]
+        while stack:
+            work, parent, side = stack.pop()
+            if not work:
+                continue
+            node, ins, outs = self._make_node(work, rng)
+            setattr(parent, side, node)
+            if ins is not None:
+                stack.append((ins, node, "inside"))
+                stack.append((outs, node, "outside"))
+        return root
 
     # --------------------------------------------------------------- search
     def search(self, target, k: int) -> Tuple[List[int], List[float]]:
@@ -91,23 +108,28 @@ class VPTree:
                 if len(heap) == k:
                     tau[0] = -heap[0][0]
 
-        def visit(node: Optional[_Node]):
+        # iterative near-first traversal (far side pushed with its pruning
+        # test deferred to pop time, when tau is tighter)
+        stack: List[Tuple[Optional[_Node], Optional[float], Optional[float]]] = [
+            (self._root, None, None)]
+        while stack:
+            node, parent_d, parent_radius = stack.pop()
             if node is None:
-                return
+                continue
+            if parent_d is not None:  # deferred far-side prune
+                if not (parent_d - tau[0] <= parent_radius <= parent_d + tau[0]
+                        or len(heap) < k):
+                    continue
             d = float(self._dist_many([node.index], target)[0])
             offer(d, node.index)
             if node.bucket is not None:
                 for bd, bi in zip(self._dist_many(node.bucket, target),
                                   node.bucket):
                     offer(float(bd), bi)
-                return
-            # best-first: descend the likelier side, prune with tau
+                continue
             near, far = ((node.inside, node.outside) if d < node.radius
                          else (node.outside, node.inside))
-            visit(near)
-            if d - tau[0] <= node.radius <= d + tau[0] or len(heap) < k:
-                visit(far)
-
-        visit(self._root)
+            stack.append((far, d, node.radius))   # popped after near subtree
+            stack.append((near, None, None))
         pairs = sorted((-nd, i) for nd, i in heap)
         return [i for _, i in pairs], [d for d, _ in pairs]

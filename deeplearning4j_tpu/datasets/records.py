@@ -153,13 +153,20 @@ class RecordReaderDataSetIterator(DataSetIterator):
         try:
             return np.asarray(rows, np.float32)
         except (ValueError, TypeError):
-            bad = next(v for row in rows
-                       for v in (row if isinstance(row, (list, tuple)) else [row])
-                       if isinstance(v, str))
+            bad = next((v for row in rows
+                        for v in (row if isinstance(row, (list, tuple)) else [row])
+                        if isinstance(v, str)), None)
+            if bad is not None:
+                raise ValueError(
+                    f"Non-numeric value {bad!r} in {what}; map string fields "
+                    "to numbers before batching (string class labels in the "
+                    "label column are mapped automatically)") from None
+            widths = sorted({len(r) for r in rows
+                             if isinstance(r, (list, tuple))})
             raise ValueError(
-                f"Non-numeric value {bad!r} in {what}; map string fields to "
-                "numbers before batching (string class labels in the label "
-                "column are mapped automatically)") from None
+                f"Cannot assemble {what} into an array"
+                + (f": ragged record lengths {widths}" if len(widths) > 1
+                   else "")) from None
 
     def _split(self, rows: List[list]):
         li = self.label_index

@@ -6,3 +6,5 @@ from deeplearning4j_tpu.models.vgg import VGG16, VGG19  # noqa: F401
 from deeplearning4j_tpu.models.resnet50 import ResNet50  # noqa: F401
 from deeplearning4j_tpu.models.darknet import Darknet19, TinyYOLO  # noqa: F401
 from deeplearning4j_tpu.models.textgenlstm import TextGenerationLSTM  # noqa: F401
+from deeplearning4j_tpu.models.googlenet import GoogLeNet  # noqa: F401
+from deeplearning4j_tpu.models.facenet import InceptionResNetV1, FaceNetNN4Small2  # noqa: F401

@@ -61,6 +61,20 @@ def test_vptree_duplicate_points():
     idx, d = tree.search(np.zeros(4), 3)
     assert d[0] == pytest.approx(0.0)
     assert len(idx) == 3
+    # mostly-duplicates + one outlier: splits shed O(1) points per level
+    items2 = np.vstack([np.zeros((3000, 4)), np.ones((1, 4))])
+    tree2 = VPTree(items2)
+    idx2, d2 = tree2.search(np.ones(4), 1)
+    assert idx2 == [3000] and d2[0] == pytest.approx(0.0)
+
+
+def test_kmeans_degenerate_fewer_distinct_than_k():
+    x = np.array([[0.0, 0.0], [1.0, 1.0]] * 10, np.float32)
+    assign, cents = KMeansClustering.setup(3, 20).apply_to(x)
+    assert len(cents) == 3 and np.isfinite(cents).all()
+    # assignments consistent with the returned centroids
+    d = ((x[:, None] - cents[None]) ** 2).sum(-1)
+    assert np.array_equal(assign, d.argmin(1))
 
 
 def test_kdtree_matches_brute_force():
