@@ -54,22 +54,24 @@ class VPTree:
     # ---------------------------------------------------------------- build
     def _make_node(self, work: List[int], rng):
         """Pick a vantage point, median-split the rest. Returns
-        (node, inside, outside); inside=None marks a finished leaf/bucket."""
+        (node, inside, outside) index lists (possibly empty)."""
         vp_pos = int(rng.integers(0, len(work)))
         work[0], work[vp_pos] = work[vp_pos], work[0]
         node = _Node(work[0])
         rest = work[1:]
         if not rest:
-            return node, None, None
+            return node, [], []
         d = self._dist_many(rest, self.items[node.index])
         node.radius = float(np.median(d))
         inside = [rest[i] for i in range(len(rest)) if d[i] < node.radius]
         outside = [rest[i] for i in range(len(rest)) if d[i] >= node.radius]
         if not inside:
-            # median split made no progress (ties/duplicates dominate):
-            # store the remainder in a scanned leaf bucket
-            node.bucket = outside
-            return node, None, None
+            # radius == min distance (ties/duplicates at the median): bucket
+            # ONLY the tied points; strictly-farther points keep splitting,
+            # so search stays pruned even with many duplicates
+            node.bucket = [rest[i] for i in range(len(rest))
+                           if d[i] == node.radius]
+            outside = [rest[i] for i in range(len(rest)) if d[i] > node.radius]
         return node, inside, outside
 
     def _build(self, idx: List[int], rng) -> Optional[_Node]:
@@ -79,17 +81,15 @@ class VPTree:
         if not idx:
             return None
         root, ins, outs = self._make_node(list(idx), rng)
-        stack = [] if ins is None else [(ins, root, "inside"),
-                                        (outs, root, "outside")]
+        stack = [(ins, root, "inside"), (outs, root, "outside")]
         while stack:
             work, parent, side = stack.pop()
             if not work:
                 continue
             node, ins, outs = self._make_node(work, rng)
             setattr(parent, side, node)
-            if ins is not None:
-                stack.append((ins, node, "inside"))
-                stack.append((outs, node, "outside"))
+            stack.append((ins, node, "inside"))
+            stack.append((outs, node, "outside"))
         return root
 
     # --------------------------------------------------------------- search
@@ -122,11 +122,14 @@ class VPTree:
                     continue
             d = float(self._dist_many([node.index], target)[0])
             offer(d, node.index)
-            if node.bucket is not None:
-                for bd, bi in zip(self._dist_many(node.bucket, target),
-                                  node.bucket):
-                    offer(float(bd), bi)
-                continue
+            if node.bucket:
+                # tied points sit exactly at node.radius from the vantage
+                # point: the scan can be skipped unless the tau-ball overlaps
+                # that shell
+                if len(heap) < k or abs(d - node.radius) <= tau[0]:
+                    for bd, bi in zip(self._dist_many(node.bucket, target),
+                                      node.bucket):
+                        offer(float(bd), bi)
             near, far = ((node.inside, node.outside) if d < node.radius
                          else (node.outside, node.inside))
             stack.append((far, d, node.radius))   # popped after near subtree

@@ -53,6 +53,10 @@ class MultiLayerConfiguration:
     seed: int = 12345
     dtype: str = "float32"
     updater: Updater = Sgd(learning_rate=0.1)  # global default updater
+    # reference OptimizationAlgorithm enum: STOCHASTIC_GRADIENT_DESCENT (the
+    # jitted minibatch path) | LBFGS | CONJUGATE_GRADIENT |
+    # LINE_GRADIENT_DESCENT (full-batch solvers, optimize/solvers.py)
+    optimization_algo: str = "stochastic_gradient_descent"
     backprop_type: str = "standard"  # "standard" | "tbptt"
     tbptt_fwd_length: int = 20
     tbptt_back_length: int = 20
@@ -115,6 +119,7 @@ class MultiLayerConfiguration:
             "seed": self.seed,
             "dtype": self.dtype,
             "updater": self.updater.to_dict(),
+            "optimization_algo": self.optimization_algo,
             "backprop_type": self.backprop_type,
             "tbptt_fwd_length": self.tbptt_fwd_length,
             "tbptt_back_length": self.tbptt_back_length,
@@ -140,6 +145,8 @@ class MultiLayerConfiguration:
             seed=d.get("seed", 12345),
             dtype=d.get("dtype", "float32"),
             updater=Updater.from_dict(d["updater"]),
+            optimization_algo=d.get("optimization_algo",
+                                    "stochastic_gradient_descent"),
             backprop_type=d.get("backprop_type", "standard"),
             tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
             tbptt_back_length=d.get("tbptt_back_length", 20),
@@ -232,6 +239,12 @@ class ListBuilder:
         self._tbptt_fwd = 20
         self._tbptt_back = 20
         self._preprocessors: Dict[int, object] = {}
+        self._optimization_algo = "stochastic_gradient_descent"
+
+    def optimization_algo(self, algo: str) -> "ListBuilder":
+        """reference NeuralNetConfiguration.Builder.optimizationAlgo."""
+        self._optimization_algo = algo.lower()
+        return self
 
     def layer(self, conf: Layer) -> "ListBuilder":
         self._layers.append(_apply_layer_defaults(conf, self._parent._defaults))
@@ -259,6 +272,7 @@ class ListBuilder:
             seed=self._parent._seed,
             dtype=self._parent._dtype,
             updater=self._parent._updater,
+            optimization_algo=self._optimization_algo,
             backprop_type=self._backprop_type,
             tbptt_fwd_length=self._tbptt_fwd,
             tbptt_back_length=self._tbptt_back,

@@ -402,6 +402,25 @@ class MultiLayerNetwork:
             data = [DataSet(np.asarray(data), np.asarray(labels))]
         elif isinstance(data, DataSet):
             data = [data]
+        if self.conf.optimization_algo not in (
+                "sgd", "stochastic_gradient_descent"):
+            # full-batch solver path (reference Solver.java dispatch on
+            # OptimizationAlgorithm — LBFGS / CG / line gradient descent)
+            from deeplearning4j_tpu.optimize.solvers import Solver
+            solver = Solver(self.conf.optimization_algo)
+            for _ in range(num_epochs):
+                for listener in self.listeners:
+                    listener.on_epoch_start(self)
+                for ds in data:
+                    solver.optimize(self, ds)
+                    self.last_batch_size = ds.num_examples()
+                    for listener in self.listeners:
+                        listener.iteration_done(self, self.iteration, self.epoch)
+                    self.iteration += 1
+                for listener in self.listeners:
+                    listener.on_epoch_end(self)
+                self.epoch += 1
+            return self
         train_step = self._get_jitted("train")
         for _ in range(num_epochs):
             for listener in self.listeners:
