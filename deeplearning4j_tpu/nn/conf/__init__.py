@@ -12,3 +12,4 @@ from deeplearning4j_tpu.nn.conf import recurrent as _rnn  # noqa: F401,E402
 from deeplearning4j_tpu.nn.conf import objdetect as _objdetect  # noqa: F401,E402
 from deeplearning4j_tpu.nn.conf import pretrain as _pretrain  # noqa: F401,E402
 from deeplearning4j_tpu.nn.conf import variational as _vae  # noqa: F401,E402
+from deeplearning4j_tpu.nn.conf import regularization as _reg  # noqa: F401,E402

@@ -56,6 +56,9 @@ def layer_to_dict(conf) -> dict:
             v = v.to_dict()
         elif dataclasses.is_dataclass(v) and hasattr(v, "to_dict"):
             v = v.to_dict()
+        elif isinstance(v, (tuple, list)):
+            v = [e.to_dict() if dataclasses.is_dataclass(e)
+                 and hasattr(e, "to_dict") else e for e in v]
         d[f.name] = v
     return d
 
@@ -75,7 +78,9 @@ def layer_from_dict(d: dict):
         elif isinstance(v, dict) and "@class" in v:  # nested layer (e.g. Bidirectional)
             v = layer_from_dict(v)
         elif isinstance(v, list):  # JSON has no tuples
-            v = tuple(v)
+            v = tuple(layer_from_dict(e)
+                      if isinstance(e, dict) and "@class" in e else e
+                      for e in v)
         kwargs[k] = v
     return cls(**kwargs)
 
@@ -106,14 +111,102 @@ def regularization_coefficients(layer):
     return vals
 
 
-def dropout_input(x, dropout: float, train: bool, rng):
+def dropout_input(x, dropout, train: bool, rng):
     """Inverted dropout on layer input (reference: Dropout.applyDropout via
-    BaseLayer.applyDropOutIfNecessary; retain-prob semantics of DL4J 0.9)."""
+    BaseLayer.applyDropOutIfNecessary; retain-prob semantics of DL4J 0.9).
+    ``dropout`` may be a plain retain probability or an IDropout object
+    (AlphaDropout/GaussianDropout/GaussianNoise — nn/conf/regularization)."""
+    if hasattr(dropout, "apply"):
+        return dropout.apply(x, rng, train)
     if not train or not dropout or dropout >= 1.0 or rng is None:
         return x
     keep = dropout
     m = jax.random.bernoulli(rng, keep, x.shape)
     return jnp.where(m, x / keep, 0.0).astype(x.dtype)
+
+
+def _set_param_path(params: dict, key: str, value):
+    """Set a possibly-nested '/'-separated param key in place."""
+    node = params
+    parts = key.split("/")
+    for part in parts[:-1]:
+        node = node[part]
+    node[parts[-1]] = value
+
+
+def reg_object(layer, attr: str):
+    """Resolve ``constraints``/``weight_noise`` on a layer, falling back to
+    the wrapped layer for wrapper configs (Bidirectional etc.) — same
+    fallthrough as regularization_coefficients."""
+    v = getattr(layer, attr, None)
+    if v is None:
+        inner = getattr(layer, "layer", None)
+        if inner is not None:
+            return reg_object(inner, attr)
+    return v
+
+
+def _bias_keys(layer, params: dict) -> list:
+    """Bias param paths: top-level 'b' plus the sibling of every nested
+    weight path (e.g. 'fwd/W' -> 'fwd/b' for wrapper layers)."""
+    keys = []
+    if resolve_param_path(params, "b") is not None:
+        keys.append("b")
+    for wk in layer.regularizable():
+        if "/" in wk:
+            bk = wk.rsplit("/", 1)[0] + "/b"
+            if bk not in keys and resolve_param_path(params, bk) is not None:
+                keys.append(bk)
+    return keys
+
+
+def _constraint_keys(layer, params: dict, c) -> list:
+    keys = []
+    if getattr(c, "apply_to_weights", True):
+        keys.extend(k for k in layer.regularizable()
+                    if resolve_param_path(params, k) is not None)
+    if getattr(c, "apply_to_biases", False):
+        keys.extend(_bias_keys(layer, params))
+    return keys
+
+
+def apply_constraints(layer, params):
+    """Apply the layer's parameter constraints after an update (reference
+    BaseConstraint.applyConstraint, called from BaseMultiLayerUpdater).
+    ``params`` must be a freshly-built dict (it is mutated in place inside
+    the traced step)."""
+    cons = reg_object(layer, "constraints")
+    if not cons:
+        return params
+    for c in cons:
+        for key in _constraint_keys(layer, params, c):
+            _set_param_path(params, key,
+                            c.apply(resolve_param_path(params, key)))
+    return params
+
+
+def noisy_params(layer, params, rng, train: bool):
+    """Apply the layer's weight noise for a training forward pass (reference
+    BaseLayer.getParamWithNoise via IWeightNoise). Uses a stream folded off
+    the layer's dropout key so the two draws are independent."""
+    wn = reg_object(layer, "weight_noise")
+    if wn is None or not train or rng is None:
+        return params
+    out = dict(params)
+    keys = [k for k in layer.regularizable()
+            if resolve_param_path(params, k) is not None]
+    if wn.apply_to_bias:
+        keys.extend(_bias_keys(layer, params))
+    for i, key in enumerate(keys):
+        sub = jax.random.fold_in(rng, 7919 + i)
+        if "/" in key:  # nested (wrapper layers): rebuild the nested dicts
+            top, restk = key.split("/", 1)
+            inner = dict(out[top])
+            inner[restk] = wn.apply_to_param(inner[restk], sub)
+            out[top] = inner
+        else:
+            out[key] = wn.apply_to_param(out[key], sub)
+    return out
 
 
 @dataclasses.dataclass(frozen=True)
@@ -177,6 +270,11 @@ class BaseLayer(Layer):
     updater: Optional[Updater] = None
     gradient_normalization: Optional[str] = None
     gradient_normalization_threshold: float = 1.0
+    # post-update parameter constraints (nn/conf/regularization.py;
+    # reference nn/conf/constraint/)
+    constraints: Optional[tuple] = None
+    # training-forward weight noise (reference nn/conf/weightnoise/)
+    weight_noise: Optional[object] = None
 
     def regularizable(self):
         return ("W",)

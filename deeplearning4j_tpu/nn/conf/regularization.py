@@ -1,0 +1,227 @@
+"""Dropout variants, parameter constraints, weight noise.
+
+Parity surface: reference ``nn/conf/dropout/`` (Dropout.java,
+AlphaDropout.java, GaussianDropout.java, GaussianNoise.java — the IDropout
+SPI applied to layer inputs), ``nn/conf/constraint/`` (MaxNormConstraint,
+MinMaxNormConstraint, UnitNormConstraint, NonNegativeConstraint — applied to
+parameters after each update, BaseConstraint.applyConstraint), and
+``nn/conf/weightnoise/`` (DropConnect.java, WeightNoise.java — applied to
+weights during the training forward pass).
+
+All three families are frozen dataclasses living in the layer config, so
+they trace into the jitted train step (no host round trips) and serialize
+with the layer JSON.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.layers import register_layer, layer_to_dict
+from deeplearning4j_tpu.nn.initializers import Distribution
+
+
+# ------------------------------------------------------------------ dropout
+@dataclasses.dataclass(frozen=True)
+class IDropout:
+    """Dropout SPI (reference nn/conf/dropout/IDropout.java): transforms the
+    layer INPUT at train time."""
+
+    def apply(self, x, rng, train: bool):
+        raise NotImplementedError
+
+    def to_dict(self):
+        return layer_to_dict(self)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class Dropout(IDropout):
+    """Inverted dropout; ``p`` is the RETAIN probability (DL4J 0.9
+    semantics, Dropout.java)."""
+
+    p: float = 0.5
+
+    def apply(self, x, rng, train):
+        if not train or rng is None or self.p >= 1.0 or self.p <= 0.0:
+            return x
+        m = jax.random.bernoulli(rng, self.p, x.shape)
+        return jnp.where(m, x / self.p, 0.0).astype(x.dtype)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class AlphaDropout(IDropout):
+    """SELU-preserving dropout (reference AlphaDropout.java): dropped units
+    take the negative saturation value alpha', and an affine correction
+    keeps zero mean / unit variance. ``p`` is the retain probability."""
+
+    p: float = 0.95
+    # fixed SELU constants (AlphaDropout.java: DEFAULT_ALPHA/LAMBDA product)
+    _ALPHA_PRIME = -1.7580993408473766
+
+    def apply(self, x, rng, train):
+        if not train or rng is None or self.p >= 1.0 or self.p <= 0.0:
+            return x
+        p, ap = self.p, self._ALPHA_PRIME
+        a = (p + ap * ap * p * (1 - p)) ** -0.5
+        b = -a * (1 - p) * ap
+        m = jax.random.bernoulli(rng, p, x.shape)
+        return (a * jnp.where(m, x, ap) + b).astype(x.dtype)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class GaussianDropout(IDropout):
+    """Multiplicative gaussian noise N(1, rate/(1-rate)) (reference
+    GaussianDropout.java)."""
+
+    rate: float = 0.1
+
+    def __post_init__(self):
+        if not 0.0 <= self.rate < 1.0:
+            raise ValueError(f"GaussianDropout rate must be in [0, 1); got "
+                             f"{self.rate}")
+
+    def apply(self, x, rng, train):
+        if not train or rng is None or self.rate <= 0.0:
+            return x
+        stddev = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = 1.0 + stddev * jax.random.normal(rng, x.shape, x.dtype)
+        return x * noise
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class GaussianNoise(IDropout):
+    """Additive gaussian noise N(0, stddev) at train time (reference
+    GaussianNoise.java)."""
+
+    stddev: float = 0.1
+
+    def apply(self, x, rng, train):
+        if not train or rng is None or self.stddev <= 0.0:
+            return x
+        return x + self.stddev * jax.random.normal(rng, x.shape, x.dtype)
+
+
+# -------------------------------------------------------------- constraints
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class BaseConstraint:
+    """Parameter constraint applied AFTER each update (reference
+    nn/conf/constraint/BaseConstraint.java). Norms reduce over every axis
+    but the last (per output unit: columns of dense W, filters of conv
+    kernels — the reference's default dimension handling)."""
+
+    apply_to_weights: bool = True
+    apply_to_biases: bool = False
+
+    def apply(self, param):
+        raise NotImplementedError
+
+    def to_dict(self):
+        return layer_to_dict(self)
+
+    @staticmethod
+    def _norms(w):
+        axes = tuple(range(w.ndim - 1)) if w.ndim > 1 else (0,)
+        return jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True) + 1e-12)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class MaxNormConstraint(BaseConstraint):
+    """Rescale units whose L2 norm exceeds max_norm (MaxNormConstraint.java)."""
+
+    max_norm: float = 2.0
+
+    def apply(self, param):
+        n = self._norms(param)
+        return param * (jnp.minimum(n, self.max_norm) / n)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class MinMaxNormConstraint(BaseConstraint):
+    """Clamp unit norms into [min_norm, max_norm] with blending ``rate``
+    (MinMaxNormConstraint.java)."""
+
+    min_norm: float = 0.0
+    max_norm: float = 2.0
+    rate: float = 1.0
+
+    def apply(self, param):
+        n = self._norms(param)
+        clipped = jnp.clip(n, self.min_norm, self.max_norm)
+        scale = self.rate * (clipped / n) + (1.0 - self.rate)
+        return param * scale
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class UnitNormConstraint(BaseConstraint):
+    """Force unit L2 norms (UnitNormConstraint.java)."""
+
+    def apply(self, param):
+        return param / self._norms(param)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class NonNegativeConstraint(BaseConstraint):
+    """Clamp params at zero (NonNegativeConstraint.java)."""
+
+    def apply(self, param):
+        return jnp.maximum(param, 0.0)
+
+
+# ------------------------------------------------------------- weight noise
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class IWeightNoise:
+    """Weight-noise SPI (reference nn/conf/weightnoise/IWeightNoise.java):
+    transforms WEIGHTS during the training forward pass."""
+
+    apply_to_bias: bool = False
+
+    def apply_to_param(self, w, rng):
+        raise NotImplementedError
+
+    def to_dict(self):
+        return layer_to_dict(self)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class DropConnect(IWeightNoise):
+    """Bernoulli weight dropout (reference DropConnect.java); ``p`` is the
+    retain probability, inverted-scaled so expectations match at test time."""
+
+    p: float = 0.5
+
+    def apply_to_param(self, w, rng):
+        if self.p >= 1.0 or self.p <= 0.0:
+            return w
+        m = jax.random.bernoulli(rng, self.p, w.shape)
+        return jnp.where(m, w / self.p, 0.0).astype(w.dtype)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class WeightNoise(IWeightNoise):
+    """Additive or multiplicative noise drawn from ``dist`` (reference
+    WeightNoise.java)."""
+
+    dist: Optional[Distribution] = None
+    additive: bool = True
+    stddev: float = 0.01  # used when dist is None: N(0, stddev)
+
+    def apply_to_param(self, w, rng):
+        if self.dist is not None:
+            noise = self.dist.sample(rng, w.shape, w.dtype)
+        else:
+            noise = self.stddev * jax.random.normal(rng, w.shape, w.dtype)
+        return w + noise if self.additive else w * noise

@@ -24,7 +24,8 @@ from deeplearning4j_tpu.nn.activations import get_activation
 from deeplearning4j_tpu.nn.conf.graph import (
     ComputationGraphConfiguration, DuplicateToTimeSeriesVertex, LastTimeStepVertex,
 )
-from deeplearning4j_tpu.nn.conf.layers import Layer, dropout_input
+from deeplearning4j_tpu.nn.conf.layers import (Layer, apply_constraints,
+                                               dropout_input, noisy_params)
 from deeplearning4j_tpu.optimize.updaters import gradient_normalization
 
 
@@ -125,9 +126,10 @@ class ComputationGraph:
                 if name in self._vpre:
                     xs = list(xs)
                     xs[0], in_mask = self._vpre[name].apply(xs[0], in_mask)
+                p_v = noisy_params(obj, params[name], k, train)
                 if obj.is_output_layer():
                     x_in = dropout_input(xs[0], obj.dropout, train, k)
-                    z = obj.pre_output(params[name], x_in)
+                    z = obj.pre_output(p_v, x_in)
                     # loss math in f32 (z may be a pytree: CenterLoss/YOLO)
                     z = jax.tree_util.tree_map(
                         lambda a: a.astype(jnp.float32)
@@ -136,7 +138,7 @@ class ComputationGraph:
                     out = obj.output_activations(z)
                     new_state[name] = state[name]
                 else:
-                    out, st = obj.apply(params[name], state[name], xs[0],
+                    out, st = obj.apply(p_v, state[name], xs[0],
                                         train=train, rng=k, mask=in_mask)
                     new_state[name] = st
                 out_kind = obj.output_type(self.vertex_input_types[name][0]).kind
@@ -206,7 +208,8 @@ class ComputationGraph:
             for n in self._layer_names:
                 g = self._gnorms[n](grads[n])
                 updates, os = self._txs[n].update(g, opt_state[n], params[n])
-                new_params[n] = optax.apply_updates(params[n], updates)
+                new_params[n] = apply_constraints(
+                    self.vertices[n][0], optax.apply_updates(params[n], updates))
                 new_opt[n] = os
             return new_params, new_state, new_opt, loss
 

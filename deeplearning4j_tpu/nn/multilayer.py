@@ -24,7 +24,8 @@ import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.nn.activations import get_activation
-from deeplearning4j_tpu.nn.conf.layers import dropout_input
+from deeplearning4j_tpu.nn.conf.layers import (apply_constraints,
+                                               dropout_input, noisy_params)
 from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
 from deeplearning4j_tpu.optimize.updaters import gradient_normalization
 import optax
@@ -130,9 +131,10 @@ class MultiLayerNetwork:
             k = None
             if rng is not None:
                 rng, k = jax.random.split(rng)
+            p_i = noisy_params(layer, params[i], k, train)
             if i == n - 1 and layer.is_output_layer():
                 x_in = dropout_input(x, layer.dropout, train, k)
-                preout = layer.pre_output(params[i], x_in)
+                preout = layer.pre_output(p_i, x_in)
                 # loss math in f32 (preout may be a pytree: CenterLoss/YOLO)
                 preout = jax.tree_util.tree_map(
                     lambda a: a.astype(jnp.float32)
@@ -143,12 +145,12 @@ class MultiLayerNetwork:
             elif (carries is not None and hasattr(layer, "apply_seq")
                   and getattr(layer, "supports_stateful", True)):
                 x_in = dropout_input(x, layer.dropout, train, k)
-                x, nc = layer.apply_seq(params[i], carries[i], x_in,
+                x, nc = layer.apply_seq(p_i, carries[i], x_in,
                                         train=train, rng=None, mask=cur_mask)
                 new_state.append(state[i])
                 new_carries.append(nc)
             else:
-                x, st = layer.apply(params[i], state[i], x, train=train, rng=k, mask=cur_mask)
+                x, st = layer.apply(p_i, state[i], x, train=train, rng=k, mask=cur_mask)
                 new_state.append(st)
                 new_carries.append({})
             acts.append(x)
@@ -205,7 +207,8 @@ class MultiLayerNetwork:
             for i, tx in enumerate(self._txs):
                 g = self._gnorms[i](grads[i])
                 updates, os = tx.update(g, opt_state[i], params[i])
-                new_params.append(optax.apply_updates(params[i], updates))
+                new_params.append(apply_constraints(
+                    self.layers[i], optax.apply_updates(params[i], updates)))
                 new_opt.append(os)
             return new_params, new_state, new_opt, loss
 
@@ -241,7 +244,8 @@ class MultiLayerNetwork:
             for i, tx in enumerate(self._txs):
                 g = self._gnorms[i](grads[i])
                 updates, os = tx.update(g, opt_state[i], params[i])
-                new_params.append(optax.apply_updates(params[i], updates))
+                new_params.append(apply_constraints(
+                    self.layers[i], optax.apply_updates(params[i], updates)))
                 new_opt.append(os)
             return new_params, new_state, new_opt, new_carries, loss
 
@@ -372,7 +376,9 @@ class MultiLayerNetwork:
                 loss, g = grad_fn(p_i, below_params, below_state, s_i, x, rng)
                 g = self._gnorms[i](g)
                 updates, opt_i = self._txs[i].update(g, opt_i, p_i)
-                return optax.apply_updates(p_i, updates), opt_i, loss
+                new_p = apply_constraints(self.layers[i],
+                                          optax.apply_updates(p_i, updates))
+                return new_p, opt_i, loss
 
             step = jax.jit(step, donate_argnums=(0, 1))
             self._jit_cache[key] = step

@@ -95,16 +95,36 @@ class Solver:
 
         return flat0, unravel, value_fn
 
+    @staticmethod
+    def _make_projection(net, unravel):
+        """Per-iteration parameter-constraint projection (reference applies
+        BaseConstraint after EVERY update regardless of solver). None when no
+        layer has constraints."""
+        from deeplearning4j_tpu.nn.conf.layers import (apply_constraints,
+                                                       reg_object)
+        layers = getattr(net, "layers", None)
+        if not layers or not any(reg_object(l, "constraints") for l in layers):
+            return None
+
+        @jax.jit
+        def project(w):
+            params = [apply_constraints(l, p)
+                      for l, p in zip(layers, unravel(w))]
+            return ravel_pytree(params)[0]
+
+        return project
+
     # ----------------------------------------------------------- algorithms
     def optimize(self, net, ds) -> float:
         """Run the solver; returns the final score and updates net.params."""
         if net.params is None:
             net.init()
         flat0, unravel, value_fn = self._flat_loss(net, ds)
+        project = self._make_projection(net, unravel)
         if self.algo == "lbfgs":
-            w = self._run_lbfgs(flat0, value_fn)
+            w = self._run_lbfgs(flat0, value_fn, project)
         else:
-            w = self._run_cg(flat0, value_fn,
+            w = self._run_cg(flat0, value_fn, project,
                              use_conjugacy=self.algo == "conjugate_gradient")
         net.params = jax.tree_util.tree_map(
             lambda a: a, unravel(w))  # fresh arrays back into the net
@@ -112,7 +132,7 @@ class Solver:
         net._score = final
         return final
 
-    def _run_lbfgs(self, w, value_fn):
+    def _run_lbfgs(self, w, value_fn, project=None):
         opt = optax.lbfgs(memory_size=self.memory)
         state = opt.init(w)
         value_and_grad = optax.value_and_grad_from_state(value_fn)
@@ -130,6 +150,8 @@ class Solver:
         prev = np.inf
         for _ in range(self.max_iterations):
             w, state, value = step(w, state)
+            if project is not None:
+                w = project(w)
             v = float(value)
             self.score_history.append(v)
             if abs(prev - v) < self.tol:
@@ -137,7 +159,7 @@ class Solver:
             prev = v
         return w
 
-    def _run_cg(self, w, value_fn, use_conjugacy: bool):
+    def _run_cg(self, w, value_fn, project=None, use_conjugacy: bool = True):
         """Polak-Ribiere+ nonlinear CG (reference ConjugateGradient.java);
         with ``use_conjugacy=False`` this is LineGradientDescent (steepest
         descent + line search)."""
@@ -157,6 +179,8 @@ class Solver:
                 if alpha == 0.0:
                     break
             w = w + alpha * d
+            if project is not None:
+                w = project(w)
             g_new = grad_fn(w)
             if use_conjugacy:
                 beta = float(jnp.vdot(g_new, g_new - g)

@@ -1,0 +1,178 @@
+"""Dropout variants, parameter constraints, weight noise.
+
+Mirrors the reference's TestConstraints.java, TestDropout.java and
+TestWeightNoise.java (deeplearning4j-core/src/test/.../nn/.../misc & conf).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import (InputType, MultiLayerConfiguration,
+                                        NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.regularization import (
+    AlphaDropout, DropConnect, Dropout, GaussianDropout, GaussianNoise,
+    MaxNormConstraint, MinMaxNormConstraint, NonNegativeConstraint,
+    UnitNormConstraint, WeightNoise,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.updaters import Sgd
+
+
+def net_with(layer0_kwargs=None, out_kwargs=None, seed=42):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(Sgd(learning_rate=0.5))
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=12, activation="tanh",
+                              **(layer0_kwargs or {})))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent",
+                               **(out_kwargs or {})))
+            .set_input_type(InputType.feed_forward(6))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def toy(n=40, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return DataSet(x, y)
+
+
+# -------------------------------------------------------- dropout variants
+def _rngkey():
+    import jax
+    return jax.random.key(0)
+
+
+def test_dropout_variants_identity_at_inference():
+    x = np.random.default_rng(0).standard_normal((64, 32)).astype(np.float32)
+    for d in (Dropout(0.5), AlphaDropout(0.9), GaussianDropout(0.3),
+              GaussianNoise(0.5)):
+        out = np.asarray(d.apply(x, _rngkey(), train=False))
+        assert np.array_equal(out, x), type(d).__name__
+
+
+def test_alpha_dropout_preserves_moments():
+    import jax
+    x = np.random.default_rng(1).standard_normal((200, 500)).astype(np.float32)
+    out = np.asarray(AlphaDropout(0.9).apply(x, _rngkey(), train=True))
+    # self-normalizing contract: mean ~0, var ~1 preserved
+    assert abs(out.mean()) < 0.05
+    assert abs(out.std() - 1.0) < 0.05
+    # dropped positions carry the transformed saturation value, not 0
+    assert (out == 0).mean() < 0.01
+
+
+def test_gaussian_dropout_mean_preserving():
+    x = np.ones((400, 400), np.float32)
+    out = np.asarray(GaussianDropout(0.2).apply(x, _rngkey(), train=True))
+    assert abs(out.mean() - 1.0) < 0.01
+    assert out.std() == pytest.approx((0.2 / 0.8) ** 0.5, rel=0.05)
+
+
+def test_dropout_object_on_layer_trains():
+    net = net_with({"dropout": AlphaDropout(0.9)})
+    ds = toy()
+    net.fit(ds)
+    assert np.isfinite(net.score())
+    # inference path ignores dropout: deterministic outputs
+    a = net.output(ds.features)
+    b = net.output(ds.features)
+    assert np.array_equal(a, b)
+
+
+# ------------------------------------------------------------- constraints
+def _weight_col_norms(w):
+    return np.linalg.norm(np.asarray(w), axis=0)
+
+
+@pytest.mark.parametrize("constraint,check", [
+    (MaxNormConstraint(max_norm=0.5),
+     lambda n: (n <= 0.5 + 1e-5).all()),
+    (UnitNormConstraint(),
+     lambda n: np.allclose(n, 1.0, atol=1e-5)),
+    (MinMaxNormConstraint(min_norm=0.3, max_norm=0.6),
+     lambda n: ((n >= 0.3 - 1e-5) & (n <= 0.6 + 1e-5)).all()),
+])
+def test_constraints_enforced_after_updates(constraint, check):
+    net = net_with({"constraints": (constraint,)})
+    ds = toy()
+    for _ in range(3):
+        net.fit(ds)
+    assert check(_weight_col_norms(net.params[0]["W"]))
+
+
+def test_non_negative_constraint():
+    net = net_with({"constraints": (NonNegativeConstraint(),)})
+    ds = toy()
+    net.fit(ds)
+    assert np.asarray(net.params[0]["W"]).min() >= 0.0
+
+
+def test_constraint_with_bias():
+    c = MaxNormConstraint(max_norm=0.1, apply_to_biases=True)
+    net = net_with({"constraints": (c,)})
+    for _ in range(3):
+        net.fit(toy())
+    assert np.linalg.norm(np.asarray(net.params[0]["b"])) <= 0.1 + 1e-5
+
+
+def test_constraints_positional_args():
+    # reference-style positional construction must hit the main parameter,
+    # not the inherited apply_to_* flags
+    assert MaxNormConstraint(0.5).max_norm == 0.5
+    assert MaxNormConstraint(0.5).apply_to_weights is True
+    assert DropConnect(0.3).p == 0.3
+    with pytest.raises(ValueError, match="rate"):
+        GaussianDropout(1.5)
+
+
+def test_constraints_enforced_under_lbfgs_solver():
+    from deeplearning4j_tpu.optimize.solvers import Solver
+    net = net_with({"constraints": (MaxNormConstraint(max_norm=0.4),)})
+    Solver("lbfgs", max_iterations=15).optimize(net, toy())
+    assert (_weight_col_norms(net.params[0]["W"]) <= 0.4 + 1e-4).all()
+
+
+# ------------------------------------------------------------ weight noise
+def test_dropconnect_train_only():
+    ds = toy()
+    plain = net_with(seed=7)
+    noisy = net_with({"weight_noise": DropConnect(p=0.5)}, seed=7)
+    # identical init => identical INFERENCE outputs (noise is train-only)
+    assert np.allclose(plain.output(ds.features), noisy.output(ds.features))
+    # training diverges the two (weights see different effective values)
+    plain.fit(ds)
+    noisy.fit(ds)
+    assert not np.allclose(np.asarray(plain.params[0]["W"]),
+                           np.asarray(noisy.params[0]["W"]))
+    assert np.isfinite(noisy.score())
+
+
+def test_weight_noise_additive():
+    net = net_with({"weight_noise": WeightNoise(stddev=0.05)})
+    net.fit(toy())
+    assert np.isfinite(net.score())
+
+
+# ------------------------------------------------------------------- serde
+def test_regularization_serde_roundtrip():
+    net = net_with(
+        {"constraints": (MaxNormConstraint(max_norm=1.5),
+                         NonNegativeConstraint()),
+         "weight_noise": DropConnect(p=0.7),
+         "dropout": GaussianDropout(0.25)})
+    back = MultiLayerConfiguration.from_json(net.conf.to_json())
+    l0 = back.layers[0]
+    assert l0.constraints == (MaxNormConstraint(max_norm=1.5),
+                              NonNegativeConstraint())
+    assert l0.weight_noise == DropConnect(p=0.7)
+    assert l0.dropout == GaussianDropout(0.25)
+    # rebuilt net still trains
+    MultiLayerNetwork(back).init().fit(toy())
