@@ -116,13 +116,12 @@ def dropout_input(x, dropout, train: bool, rng):
     BaseLayer.applyDropOutIfNecessary; retain-prob semantics of DL4J 0.9).
     ``dropout`` may be a plain retain probability or an IDropout object
     (AlphaDropout/GaussianDropout/GaussianNoise — nn/conf/regularization)."""
-    if hasattr(dropout, "apply"):
-        return dropout.apply(x, rng, train)
-    if not train or not dropout or dropout >= 1.0 or rng is None:
+    if not dropout:  # None / 0.0: disabled
         return x
-    keep = dropout
-    m = jax.random.bernoulli(rng, keep, x.shape)
-    return jnp.where(m, x / keep, 0.0).astype(x.dtype)
+    if not hasattr(dropout, "apply"):
+        from deeplearning4j_tpu.nn.conf.regularization import Dropout
+        dropout = Dropout(float(dropout))  # single implementation of the math
+    return dropout.apply(x, rng, train)
 
 
 def _set_param_path(params: dict, key: str, value):

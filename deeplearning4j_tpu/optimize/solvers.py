@@ -135,7 +135,15 @@ class Solver:
     def _run_lbfgs(self, w, value_fn, project=None):
         opt = optax.lbfgs(memory_size=self.memory)
         state = opt.init(w)
-        value_and_grad = optax.value_and_grad_from_state(value_fn)
+        if project is None:
+            value_and_grad = optax.value_and_grad_from_state(value_fn)
+        else:
+            # the projection moves w after each update, so optax's cached
+            # value/grad (valid only for the unprojected iterate) must not be
+            # reused — recompute fresh at the projected point every step
+            plain = jax.value_and_grad(value_fn)
+            value_and_grad = lambda w, state: plain(w)  # noqa: E731
+            w = project(w)
 
         # ONE jitted program per solver iteration (value+grad, two-loop
         # recursion, zoom linesearch): running optax's update eagerly costs
@@ -145,13 +153,14 @@ class Solver:
             value, grad = value_and_grad(w, state=state)
             updates, state = opt.update(grad, state, w, value=value,
                                         grad=grad, value_fn=value_fn)
-            return optax.apply_updates(w, updates), state, value
+            w = optax.apply_updates(w, updates)
+            if project is not None:
+                w = project(w)
+            return w, state, value
 
         prev = np.inf
         for _ in range(self.max_iterations):
             w, state, value = step(w, state)
-            if project is not None:
-                w = project(w)
             v = float(value)
             self.score_history.append(v)
             if abs(prev - v) < self.tol:

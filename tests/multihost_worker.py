@@ -1,0 +1,66 @@
+"""Worker process for the 2-process ClusterTrainer parity test.
+
+Run as: python multihost_worker.py <rank> <port> <out_dir>
+Each process owns 4 virtual CPU devices; the mesh spans the 8 global devices
+and each rank feeds its half of the fixed global batch. Rank 0 writes the
+final parameters for the parent test to compare against single-process
+training (ParameterAveragingTrainingMaster.java:308 exact-averaging
+semantics).
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    rank, port, out_dir = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+
+    from deeplearning4j_tpu.datasets import IrisDataSetIterator
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    from deeplearning4j_tpu.parallel import ClusterTrainer
+
+    ClusterTrainer.initialize(coordinator_address=f"localhost:{port}",
+                              num_processes=2, process_id=rank)
+    assert jax.device_count() == 8, jax.device_count()
+    assert jax.local_device_count() == 4
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(17).updater(Sgd(learning_rate=0.05)).weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ct = ClusterTrainer(net)  # mesh over all 8 global devices
+
+    full = next(iter(IrisDataSetIterator(batch=150)))
+    half = 144 // 2
+    lo = rank * half
+    local = DataSet(full.features[lo:lo + half], full.labels[lo:lo + half])
+    ct.fit_local_shard(local, num_epochs=5)
+
+    if rank == 0:
+        flat = {f"{i}_{k}": np.asarray(v)
+                for i, p in enumerate(net.params) for k, v in p.items()}
+        np.savez(os.path.join(out_dir, "rank0_params.npz"), **flat)
+    print(f"rank{rank}-done", flush=True)
+
+
+if __name__ == "__main__":
+    main()
