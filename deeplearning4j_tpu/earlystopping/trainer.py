@@ -67,6 +67,11 @@ class EarlyStoppingTrainer:
                 return total / max(n, 1)
         self.score_calculator = score_calculator
 
+    def _fit_batch(self, ds):
+        """One training batch; overridden by the parallel trainer to route
+        through a ParallelWrapper."""
+        self.model.fit(ds)
+
     def fit(self) -> EarlyStoppingResult:
         for c in (self.config.epoch_termination_conditions
                   + self.config.iteration_termination_conditions):
@@ -81,7 +86,7 @@ class EarlyStoppingTrainer:
             # --- one epoch of training with iteration-condition checks ---
             stop_iter = None
             for ds in self.train_data:
-                self.model.fit(ds)
+                self._fit_batch(ds)
                 s = self.model.score()
                 for cond in self.config.iteration_termination_conditions:
                     if cond.terminate(s):

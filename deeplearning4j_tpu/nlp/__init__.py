@@ -6,6 +6,17 @@ cbow kernels) becomes batched device steps — windows are vectorized host-side
 into (center, context) index batches, and one jitted XLA program does the
 negative-sampling/hierarchical-softmax math with scatter-add updates
 (SURVEY §7 step 8's segment-sum design).
+
+Scope decision — UIMA + CJK tokenizer stacks
+(deeplearning4j-nlp-uima ~14k LoC, deeplearning4j-nlp-japanese/korean ~9k):
+NOT replicated. Those modules are thin adapters binding Apache UIMA's
+analysis-engine SPI and the Kuromoji/Arirang analyzers — JVM-ecosystem
+integrations, not model capability. The ``TokenizerFactory`` SPI here
+(nlp/tokenization.py) is the extension point they would plug into: a user
+needing CJK segmentation registers a factory wrapping any Python tokenizer
+(e.g. fugashi/konlpy) with identical downstream behavior. Everything the
+reference *trains* with those tokens (SequenceVectors/Word2Vec/
+ParagraphVectors/TF-IDF) is implemented and tokenizer-agnostic.
 """
 
 from deeplearning4j_tpu.nlp.tokenization import (
@@ -28,9 +39,16 @@ from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 from deeplearning4j_tpu.nlp.paragraphvectors import ParagraphVectors
 from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.serializer import StaticWordVectors, WordVectorSerializer
+from deeplearning4j_tpu.nlp.vectorizers import (
+    BagOfWordsVectorizer,
+    BaseTextVectorizer,
+    TfidfVectorizer,
+)
 
 __all__ = [
     "AbstractCache",
+    "BagOfWordsVectorizer",
+    "BaseTextVectorizer",
     "BasicLineIterator",
     "CollectionSentenceIterator",
     "CommonPreprocessor",
@@ -42,6 +60,7 @@ __all__ = [
     "ParagraphVectors",
     "SentenceIterator",
     "SequenceVectors",
+    "TfidfVectorizer",
     "SimpleLabelAwareIterator",
     "StaticWordVectors",
     "TokenizerFactory",

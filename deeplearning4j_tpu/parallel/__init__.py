@@ -1,3 +1,8 @@
 from deeplearning4j_tpu.parallel.mesh import make_mesh, data_sharding, replicated  # noqa: F401
-from deeplearning4j_tpu.parallel.trainer import ParallelWrapper, ClusterTrainer  # noqa: F401
+from deeplearning4j_tpu.parallel.trainer import (  # noqa: F401
+    ClusterTrainer,
+    EarlyStoppingParallelTrainer,
+    ParallelWrapper,
+)
 from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401
+from deeplearning4j_tpu.parallel.stats import TrainingStats  # noqa: F401

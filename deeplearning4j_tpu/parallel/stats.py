@@ -1,0 +1,76 @@
+"""Distributed-training phase timing stats.
+
+Parity surface: reference
+``dl4j-spark/.../api/stats/CommonSparkTrainingStats.java:18`` (per-phase
+timing: getInitialModelAfter/fit/split times, exported key set) and
+``SparkTrainingStats`` aggregation.
+
+TPU-native phases: ``data_placement`` (host->device sharded transfer),
+``train_dispatch`` (async step dispatch), ``epoch_sync`` (the single
+block-until-ready per epoch — on TPU the real step time shows up here, since
+dispatch is asynchronous).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+
+class TrainingStats:
+    """Accumulates (phase -> durations); mirrors the reference's
+    getValue(key)/getKeySet surface with host wall-clock measurements."""
+
+    def __init__(self):
+        self._durations: Dict[str, List[float]] = {}
+        self.examples = 0
+        self.minibatches = 0
+
+    # -------------------------------------------------------------- record
+    class _Timer:
+        def __init__(self, stats: "TrainingStats", phase: str):
+            self.stats = stats
+            self.phase = phase
+
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self.stats.record(self.phase, time.perf_counter() - self.t0)
+
+    def time(self, phase: str) -> "_Timer":
+        return self._Timer(self, phase)
+
+    def record(self, phase: str, seconds: float):
+        self._durations.setdefault(phase, []).append(seconds)
+
+    # --------------------------------------------------------------- query
+    def key_set(self):
+        return sorted(self._durations)
+
+    def get_value(self, phase: str) -> List[float]:
+        return list(self._durations.get(phase, []))
+
+    def total_seconds(self, phase: str) -> float:
+        return sum(self._durations.get(phase, []))
+
+    def count(self, phase: str) -> int:
+        return len(self._durations.get(phase, []))
+
+    def as_dict(self) -> dict:
+        out = {"examples": self.examples, "minibatches": self.minibatches}
+        for phase, ds in self._durations.items():
+            out[phase] = {"count": len(ds), "total_ms": sum(ds) * 1000.0,
+                          "mean_ms": sum(ds) / len(ds) * 1000.0}
+        return out
+
+    def to_string(self) -> str:
+        lines = [f"TrainingStats: {self.examples} examples, "
+                 f"{self.minibatches} minibatches"]
+        for phase in self.key_set():
+            ds = self._durations[phase]
+            lines.append(f"  {phase:<16} n={len(ds):<6} "
+                         f"total={sum(ds) * 1000:9.1f} ms  "
+                         f"mean={sum(ds) / len(ds) * 1000:7.2f} ms")
+        return "\n".join(lines)

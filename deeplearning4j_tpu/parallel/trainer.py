@@ -18,12 +18,15 @@ deliberately not replicated.
 
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+log = logging.getLogger(__name__)
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.parallel.mesh import (
@@ -46,12 +49,18 @@ class ParallelWrapper:
 
     def __init__(self, model, mesh: Optional[Mesh] = None,
                  tensor_parallel: bool = False,
-                 prefetch_buffer: int = 2):
+                 prefetch_buffer: int = 2,
+                 collect_stats: bool = False):
+        from deeplearning4j_tpu.parallel.stats import TrainingStats
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.tensor_parallel = tensor_parallel
         self.prefetch_buffer = prefetch_buffer
         self._placed = False
+        self._warned_ragged = False
+        # phase timing (reference CommonSparkTrainingStats; enable with
+        # collect_stats=True, read via .stats)
+        self.stats = TrainingStats() if collect_stats else None
 
     # ---- parameter placement ----
     def _place_params(self):
@@ -99,20 +108,84 @@ class ParallelWrapper:
         return DataSet(put(ds.features), put(ds.labels),
                        put(ds.features_mask), put(ds.labels_mask))
 
+    def _model_fit_batch(self, sharded: DataSet):
+        """One training step WITHOUT the model's own epoch-listener side
+        effects (model.fit(DataSet) counts a full epoch, so routing batches
+        through it would fire epoch hooks once per minibatch). Uses the
+        model's internal batch path for the standard SGD case; tbptt/solver
+        configs fall back to model.fit."""
+        m = self.model
+        conf = getattr(m, "conf", None)
+        standard = (conf is not None
+                    and getattr(conf, "backprop_type", "standard") == "standard"
+                    and getattr(conf, "optimization_algo",
+                                "stochastic_gradient_descent")
+                    in ("sgd", "stochastic_gradient_descent"))
+        if standard and hasattr(m, "_fit_batch") and hasattr(m, "_get_jitted"):
+            from deeplearning4j_tpu.nn.graph import ComputationGraph
+            if isinstance(m, ComputationGraph):
+                from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+                m._fit_batch(m._get_jitted("train"),
+                             MultiDataSet.from_dataset(sharded))
+            else:
+                m._fit_batch(m._get_jitted("train"), sharded)
+        else:
+            m.fit(sharded)
+
+    def fit_batch(self, ds: DataSet, drop_ragged: bool = False) -> bool:
+        """Train on ONE global batch (sharded over the mesh); returns whether
+        the batch was trained. ``drop_ragged`` drops batches that don't
+        divide the data-parallel size instead of raising — static shapes are
+        the TPU contract, so a ragged tail is dropped, not recompiled."""
+        self._place_params()
+        dp = self.mesh.shape[DATA_AXIS]
+        if ds.num_examples() % dp and drop_ragged:
+            if not self._warned_ragged:
+                log.warning(
+                    "Dropping ragged batch of %d examples (global batch must "
+                    "divide data-parallel size %d)", ds.num_examples(), dp)
+                self._warned_ragged = True
+            return False
+        with self.mesh:
+            if self.stats is None:
+                self._model_fit_batch(self._shard_dataset(ds))
+            else:
+                with self.stats.time("data_placement"):
+                    sharded = self._shard_dataset(ds)
+                with self.stats.time("train_dispatch"):
+                    self._model_fit_batch(sharded)
+                self.stats.examples += ds.num_examples()
+                self.stats.minibatches += 1
+        return True
+
     # ---- training (reference ParallelWrapper.fit dispatch loop :210) ----
     def fit(self, data, num_epochs: int = 1):
         self._place_params()
-        if isinstance(data, DataSet):
+        explicit_single = isinstance(data, DataSet)
+        if explicit_single:
             data = [data]
-        with self.mesh:
-            for _ in range(num_epochs):
-                for listener in self.model.listeners:
-                    listener.on_epoch_start(self.model)
-                for ds in data:
-                    sharded = self._shard_dataset(ds)
-                    self.model.fit(sharded)
-                for listener in self.model.listeners:
-                    listener.on_epoch_end(self.model)
+        for _ in range(num_epochs):
+            for listener in self.model.listeners:
+                listener.on_epoch_start(self.model)
+            trained = 0
+            for ds in data:
+                # a single explicit ragged DataSet raises (dropping it would
+                # train on nothing); iterator tail batches drop-remainder
+                if self.fit_batch(ds, drop_ragged=not explicit_single):
+                    trained += 1
+            if trained == 0:
+                raise ValueError(
+                    "Every batch this epoch was dropped as ragged — the "
+                    f"batch size never divides the data-parallel size "
+                    f"{self.mesh.shape[DATA_AXIS]}; pick a divisible batch")
+            for listener in self.model.listeners:
+                listener.on_epoch_end(self.model)
+            self.model.epoch += 1
+            if self.stats is not None:
+                # steps dispatch asynchronously: one sync per epoch shows
+                # the true device time under "epoch_sync"
+                with self.stats.time("epoch_sync"):
+                    jax.block_until_ready(self.model.params)
         return self
 
     def output(self, x) -> np.ndarray:
@@ -175,3 +248,29 @@ class ClusterTrainer(ParallelWrapper):
                                            gput(ds.features_mask),
                                            gput(ds.labels_mask)))
         return self
+
+
+from deeplearning4j_tpu.earlystopping.trainer import EarlyStoppingTrainer
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    """Early stopping composed with data-parallel training (reference
+    deeplearning4j-scaleout-parallelwrapper/.../EarlyStoppingParallelTrainer.java:44).
+
+    Each training batch routes through a ParallelWrapper (global batch
+    sharded over the mesh); validation scoring runs on the same
+    replicated-parameter model, so savers/conditions see identical
+    semantics to the single-device EarlyStoppingTrainer.
+    """
+
+    def __init__(self, config, model, train_data, validation_data=None,
+                 score_calculator=None, mesh: Optional[Mesh] = None,
+                 tensor_parallel: bool = False):
+        super().__init__(config, model, train_data, validation_data,
+                         score_calculator)
+        self.wrapper = ParallelWrapper(model, mesh=mesh,
+                                       tensor_parallel=tensor_parallel)
+
+    def _fit_batch(self, ds):
+        # per-batch path: no epoch-listener double fire, ragged tails dropped
+        self.wrapper.fit_batch(ds, drop_ragged=True)

@@ -1,0 +1,66 @@
+"""Memory report tests (reference TestMemoryReports.java in
+deeplearning4j-core/src/test/.../nn/conf/memory)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.memory import get_memory_report
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.updaters import Adam, Sgd
+
+
+def _net(updater=None):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(updater or Sgd(learning_rate=0.1))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=20, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(10))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_analytic_report():
+    net = _net()
+    rep = get_memory_report(net, minibatch=16, compile_step=False)
+    assert len(rep.layers) == 2
+    d0, out = rep.layers
+    # dense 10->20: 220 params * 4 bytes
+    assert d0.num_params == 220 and d0.param_bytes == 880
+    assert d0.activation_shape == (20,)
+    assert d0.activation_bytes_per_example == 80
+    # out 20->3: 63 params
+    assert out.num_params == 63
+    assert rep.total_param_bytes == (220 + 63) * 4
+    assert rep.total_activation_bytes == (80 + 12) * 16
+    # SGD keeps no updater state
+    assert rep.updater_state_bytes == 0
+    # serialization + printable table
+    parsed = json.loads(rep.to_json())
+    assert parsed["minibatch"] == 16
+    s = rep.to_string()
+    assert "0_DenseLayer" in s and "Totals" in s
+
+
+def test_adam_state_counted():
+    rep = get_memory_report(_net(Adam(learning_rate=1e-3)), minibatch=4,
+                            compile_step=False)
+    # Adam: mu + nu per param (+ a few bytes of step counters)
+    assert 2 * rep.total_param_bytes <= rep.updater_state_bytes \
+        <= 2 * rep.total_param_bytes + 64
+    assert rep.total_fixed_bytes() >= 3 * rep.total_param_bytes
+
+
+def test_compiled_step_stats():
+    net = _net()
+    rep = get_memory_report(net, minibatch=32, compile_step=True)
+    assert rep.compiled is not None
+    # arguments include params+opt state+batch; must at least cover the batch
+    batch_bytes = 32 * 10 * 4 + 32 * 3 * 4
+    assert rep.compiled["argument_bytes"] >= batch_bytes
+    assert rep.compiled["temp_bytes"] >= 0
+    assert "Compiled train step" in rep.to_string()

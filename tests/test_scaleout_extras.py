@@ -1,0 +1,142 @@
+"""Scaleout completion tests: EarlyStoppingParallelTrainer, phase-timing
+stats, and the ParallelWrapperMain-equivalent CLI.
+
+Mirrors the reference's TestParallelEarlyStopping.java and
+ParallelWrapperMainTest.java."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import IrisDataSetIterator
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.earlystopping.conditions import (
+    MaxEpochsTerminationCondition, ScoreImprovementEpochTerminationCondition)
+from deeplearning4j_tpu.earlystopping.trainer import EarlyStoppingConfiguration
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.updaters import Adam
+from deeplearning4j_tpu.parallel import (EarlyStoppingParallelTrainer,
+                                         ParallelWrapper, TrainingStats,
+                                         make_mesh)
+
+
+def _net(seed=11):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Adam(learning_rate=0.02))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=12, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _iris(n=144):
+    ds = next(iter(IrisDataSetIterator(batch=150)))
+    return DataSet(ds.features[:n], ds.labels[:n])
+
+
+def test_early_stopping_parallel_trainer(devices):
+    ds = _iris()
+    config = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[
+            MaxEpochsTerminationCondition(30),
+            ScoreImprovementEpochTerminationCondition(5, 1e-4)])
+    trainer = EarlyStoppingParallelTrainer(
+        config, _net(), train_data=[ds], validation_data=[ds],
+        mesh=make_mesh())
+    result = trainer.fit()
+    assert result.termination_reason == "epoch_condition"
+    assert result.best_model is not None
+    assert result.best_model_score < 0.7
+    # training really went through the sharded path
+    assert trainer.wrapper._placed
+
+
+def test_early_stopping_parallel_drops_ragged_tail(devices):
+    ds = _iris()
+    ragged = DataSet(ds.features[:22], ds.labels[:22])  # 22 % 8 != 0
+    config = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(3)])
+    trainer = EarlyStoppingParallelTrainer(
+        config, _net(), train_data=[ds, ragged], validation_data=[ds],
+        mesh=make_mesh())
+    result = trainer.fit()  # must not raise on the ragged tail batch
+    assert result.total_epochs == 3
+
+
+def test_wrapper_epoch_listeners_fire_once_per_epoch(devices):
+    from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+    class Recorder(TrainingListener):
+        def __init__(self):
+            self.starts = self.ends = self.iters = 0
+
+        def on_epoch_start(self, model):
+            self.starts += 1
+
+        def on_epoch_end(self, model):
+            self.ends += 1
+
+        def iteration_done(self, model, iteration, epoch):
+            self.iters += 1
+
+    net = _net()
+    rec = Recorder()
+    net.set_listeners(rec)
+    ds = _iris()
+    batches = [DataSet(ds.features[i:i + 48], ds.labels[i:i + 48])
+               for i in range(0, 144, 48)]
+    ParallelWrapper(net, mesh=make_mesh()).fit(batches, num_epochs=2)
+    assert (rec.starts, rec.ends) == (2, 2)  # NOT once per minibatch
+    assert rec.iters == 6  # 3 batches x 2 epochs
+    assert net.epoch == 2
+
+
+def test_wrapper_all_ragged_raises(devices):
+    ds = _iris()
+    bad = [DataSet(ds.features[:50], ds.labels[:50])]  # 50 % 8 != 0, always
+    with pytest.raises(ValueError, match="ragged"):
+        ParallelWrapper(_net(), mesh=make_mesh()).fit(bad)
+
+
+def test_training_stats_collection(devices):
+    wrapper = ParallelWrapper(_net(), mesh=make_mesh(), collect_stats=True)
+    wrapper.fit(_iris(), num_epochs=3)
+    stats = wrapper.stats
+    assert stats.minibatches == 3 and stats.examples == 3 * 144
+    assert set(stats.key_set()) == {"data_placement", "train_dispatch",
+                                    "epoch_sync"}
+    assert stats.count("epoch_sync") == 3
+    assert stats.total_seconds("train_dispatch") > 0
+    d = stats.as_dict()
+    assert d["train_dispatch"]["count"] == 3
+    assert "train_dispatch" in stats.to_string()
+    json.dumps(d)
+
+
+def test_parallel_cli_roundtrip(tmp_path, devices):
+    from deeplearning4j_tpu.parallel.__main__ import main
+    from deeplearning4j_tpu.utils.serialization import restore, write_model
+    path = str(tmp_path / "model.zip")
+    net = _net()
+    s0 = net.score_dataset(_iris())
+    write_model(net, path)
+    main(["--model-path", path, "--data", "iris", "--batch", "48",
+          "--epochs", "10", "--report-stats"])
+    trained = restore(path)
+    assert trained.score_dataset(_iris()) < s0
+
+
+def test_cli_bad_data_spec(tmp_path):
+    from deeplearning4j_tpu.parallel.__main__ import build_iterator
+    with pytest.raises(SystemExit):
+        build_iterator("nope", 8)
+    # csv spec parses
+    csv = tmp_path / "d.csv"
+    csv.write_text("\n".join(f"1.0,2.0,{i % 2}" for i in range(8)))
+    it = build_iterator(f"csv:{csv}:2:2", 4)
+    assert next(iter(it)).labels.shape == (4, 2)

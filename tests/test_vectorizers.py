@@ -1,0 +1,69 @@
+"""Bag-of-words / TF-IDF tests (reference BagOfWordsVectorizerTest.java,
+TfidfVectorizerTest.java)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (BagOfWordsVectorizer, LabelledDocument,
+                                    TfidfVectorizer)
+
+DOCS = [
+    "the quick brown fox",
+    "the lazy dog",
+    "the quick dog jumps",
+    "brown foxes and lazy dogs",
+]
+
+
+def test_bag_of_words_counts():
+    v = BagOfWordsVectorizer(min_word_frequency=1)
+    v.fit(DOCS)
+    assert v.vocab_size() == len({w for d in DOCS for w in d.split()})
+    x = v.transform("the dog saw the fox")
+    assert x[v.index_of("the")] == 2.0
+    assert x[v.index_of("dog")] == 1.0
+    assert x[v.index_of("fox")] == 1.0
+    assert x.sum() == 4.0  # 'saw' is out-of-vocab
+
+
+def test_min_word_frequency_filters():
+    v = BagOfWordsVectorizer(min_word_frequency=2)
+    v.fit(DOCS)
+    words = set(v.vocab.words())
+    assert "the" in words and "quick" in words and "lazy" in words
+    assert "jumps" not in words and "foxes" not in words
+
+
+def test_tfidf_reference_formula():
+    v = TfidfVectorizer(min_word_frequency=1)
+    v.fit(DOCS)
+    # 'the' appears in 3 of 4 docs; 'fox' in 1 of 4
+    assert v.idf("the") == pytest.approx(math.log10(4 / 3))
+    assert v.idf("fox") == pytest.approx(math.log10(4 / 1))
+    x = v.transform("the fox")
+    # tf = count/docLen = 1/2 each (reference MathUtils.tf/idf/tfidf)
+    assert x[v.index_of("the")] == pytest.approx(0.5 * math.log10(4 / 3))
+    assert x[v.index_of("fox")] == pytest.approx(0.5 * math.log10(4))
+    # rare term outweighs common term
+    assert x[v.index_of("fox")] > x[v.index_of("the")]
+
+
+def test_vectorize_labelled_dataset():
+    docs = [LabelledDocument("good great fine", ["pos"]),
+            LabelledDocument("bad awful poor", ["neg"])]
+    v = BagOfWordsVectorizer()
+    v.fit(docs)
+    assert v.labels == ["pos", "neg"]
+    ds = v.vectorize("good bad bad", "neg")
+    assert ds.features.shape == (1, v.vocab_size())
+    assert ds.labels.tolist() == [[0.0, 1.0]]
+    mat = v.fit_transform(docs)
+    assert mat.shape == (2, v.vocab_size())
+
+
+def test_stop_words():
+    v = TfidfVectorizer(stop_words=("the", "and"))
+    v.fit(DOCS)
+    assert not v.vocab.contains_word("the")
