@@ -67,10 +67,12 @@ class EarlyStoppingTrainer:
                 return total / max(n, 1)
         self.score_calculator = score_calculator
 
-    def _fit_batch(self, ds):
-        """One training batch; overridden by the parallel trainer to route
-        through a ParallelWrapper."""
+    def _fit_batch(self, ds) -> bool:
+        """One training batch; returns whether the batch actually trained.
+        Overridden by the parallel trainer to route through a
+        ParallelWrapper (which may drop ragged batches)."""
         self.model.fit(ds)
+        return True
 
     def fit(self) -> EarlyStoppingResult:
         for c in (self.config.epoch_termination_conditions
@@ -85,8 +87,10 @@ class EarlyStoppingTrainer:
         while True:
             # --- one epoch of training with iteration-condition checks ---
             stop_iter = None
+            trained_any = False
             for ds in self.train_data:
-                self._fit_batch(ds)
+                if self._fit_batch(ds) is not False:
+                    trained_any = True
                 s = self.model.score()
                 for cond in self.config.iteration_termination_conditions:
                     if cond.terminate(s):
@@ -94,6 +98,10 @@ class EarlyStoppingTrainer:
                         break
                 if stop_iter is not None:
                     break
+            if not trained_any:
+                raise ValueError(
+                    "No training batch was usable this epoch (empty data, or "
+                    "every batch dropped as ragged by the parallel wrapper)")
             if stop_iter is not None:
                 reason = "iteration_condition"
                 details = type(stop_iter).__name__

@@ -27,17 +27,18 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from deeplearning4j_tpu.nn.conf.convolutional import (
-    Convolution1DLayer, ConvolutionLayer, SeparableConvolution2D,
-    Subsampling1DLayer, SubsamplingLayer, Upsampling2D, ZeroPaddingLayer,
+    Convolution1DLayer, ConvolutionLayer, Cropping1D, Cropping2D,
+    SeparableConvolution2D, Subsampling1DLayer, SubsamplingLayer,
+    Upsampling2D, ZeroPadding1DLayer, ZeroPaddingLayer,
 )
 from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex, MergeVertex
 from deeplearning4j_tpu.nn.conf.layers import (
-    ActivationLayer, DenseLayer, DropoutLayer,
+    ActivationLayer, DenseLayer, DropoutLayer, PReLULayer,
 )
 from deeplearning4j_tpu.nn.conf.normalization import BatchNormalization
 from deeplearning4j_tpu.nn.conf.pooling import GlobalPoolingLayer
 from deeplearning4j_tpu.nn.conf.recurrent import (
-    EmbeddingSequenceLayer, LSTM, LastTimeStep,
+    EmbeddingSequenceLayer, GRU, LSTM, LastTimeStep, SimpleRnn,
 )
 
 
@@ -512,3 +513,125 @@ def _concatenate(cfg, ctx):
     if mode == "mul":
         return KerasLayerSpec(layer=ElementWiseVertex(op="product"))
     raise KerasImportError(f"Unsupported Keras 1 Merge mode '{mode}'")
+
+
+@register_keras_layer("GRU")
+def _gru(cfg, ctx):
+    """Keras GRU (beyond the reference's converter set — KerasLayerConfiguration
+    has no GRU; gate order z, r, h matches our fused layout)."""
+    if not cfg.get("use_bias", True):
+        raise KerasImportError("GRU without bias is not supported")
+    reset_after = bool(cfg.get("reset_after", False))
+    inner = GRU(
+        name=cfg.get("name"),
+        n_out=int(cfg.get("units", cfg.get("output_dim", 0))),
+        activation=map_activation(cfg.get("activation", "tanh")),
+        gate_activation=map_activation(
+            cfg.get("recurrent_activation",
+                    cfg.get("inner_activation", "sigmoid"))),
+        reset_after=reset_after,
+    )
+    layer = inner if cfg.get("return_sequences", False) \
+        else LastTimeStep(name=cfg.get("name"), layer=inner)
+
+    def weights(ws):
+        out = {"W": np.asarray(ws[0]), "U": np.asarray(ws[1])}
+        b = np.asarray(ws[2])
+        if reset_after:
+            # Keras stores (2, 3n): input bias row + recurrent bias row
+            if b.ndim != 2:
+                raise KerasImportError(
+                    f"reset_after GRU expects bias shape (2, 3n); got {b.shape}")
+            out["b"], out["br"] = b[0], b[1]
+        else:
+            out["b"] = b.reshape(-1)
+        return out
+
+    return KerasLayerSpec(layer=layer, weights=weights)
+
+
+@register_keras_layer("SimpleRNN")
+def _simple_rnn(cfg, ctx):
+    if not cfg.get("use_bias", True):
+        raise KerasImportError("SimpleRNN without bias is not supported")
+    inner = SimpleRnn(
+        name=cfg.get("name"),
+        n_out=int(cfg.get("units", cfg.get("output_dim", 0))),
+        activation=map_activation(cfg.get("activation", "tanh")),
+    )
+    layer = inner if cfg.get("return_sequences", False) \
+        else LastTimeStep(name=cfg.get("name"), layer=inner)
+
+    def weights(ws):
+        return {"W": np.asarray(ws[0]), "U": np.asarray(ws[1]),
+                "b": np.asarray(ws[2]).reshape(-1)}
+
+    return KerasLayerSpec(layer=layer, weights=weights)
+
+
+@register_keras_layer("LeakyReLU")
+def _leaky_relu(cfg, ctx):
+    # reference KerasLayerConfiguration LEAKY_RELU -> ActivationLayer
+    # (Keras 1/2 call the slope "alpha"; Keras 3 "negative_slope")
+    slope = cfg.get("negative_slope", cfg.get("alpha", 0.3))
+    return KerasLayerSpec(layer=ActivationLayer(
+        name=cfg.get("name"), activation="leakyrelu",
+        activation_param=float(slope)))
+
+
+@register_keras_layer("ELU")
+def _elu_layer(cfg, ctx):
+    alpha = float(cfg.get("alpha", 1.0))
+    return KerasLayerSpec(layer=ActivationLayer(
+        name=cfg.get("name"), activation="elu",
+        activation_param=None if alpha == 1.0 else alpha))
+
+
+@register_keras_layer("ThresholdedReLU")
+def _thresholded_relu(cfg, ctx):
+    return KerasLayerSpec(layer=ActivationLayer(
+        name=cfg.get("name"), activation="thresholdedrelu",
+        activation_param=float(cfg.get("theta", 1.0))))
+
+
+@register_keras_layer("PReLU")
+def _prelu(cfg, ctx):
+    shared = cfg.get("shared_axes")
+    layer = PReLULayer(name=cfg.get("name"),
+                       shared_axes=None if not shared else tuple(shared))
+
+    def weights(ws):
+        return {"alpha": np.asarray(ws[0])}
+
+    return KerasLayerSpec(layer=layer, weights=weights)
+
+
+@register_keras_layer("Cropping2D")
+def _cropping2d(cfg, ctx):
+    c = cfg.get("cropping", ((0, 0), (0, 0)))
+    if isinstance(c, int):
+        crops = (c, c, c, c)
+    elif isinstance(c[0], (list, tuple)):
+        crops = (c[0][0], c[0][1], c[1][0], c[1][1])
+    else:
+        crops = (c[0], c[0], c[1], c[1])
+    return KerasLayerSpec(layer=Cropping2D(
+        name=cfg.get("name"), cropping=tuple(int(v) for v in crops)))
+
+
+@register_keras_layer("Cropping1D")
+def _cropping1d(cfg, ctx):
+    c = cfg.get("cropping", (1, 1))
+    if isinstance(c, int):
+        c = (c, c)
+    return KerasLayerSpec(layer=Cropping1D(
+        name=cfg.get("name"), cropping=(int(c[0]), int(c[1]))))
+
+
+@register_keras_layer("ZeroPadding1D")
+def _zero_padding1d(cfg, ctx):
+    p = cfg.get("padding", 1)
+    if isinstance(p, int):
+        p = (p, p)
+    return KerasLayerSpec(layer=ZeroPadding1DLayer(
+        name=cfg.get("name"), padding=(int(p[0]), int(p[1]))))

@@ -52,6 +52,8 @@ class BaseTextVectorizer:
         """Build vocabulary + per-word document frequencies (reference
         BaseTextVectorizer.buildVocab)."""
         from collections import Counter
+        self._doc_freq = {}  # re-fit replaces, never mixes, corpora stats
+        self.total_docs = 0
         counts: Counter = Counter()
         labels = []
         for doc in corpus:

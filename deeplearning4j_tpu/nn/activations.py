@@ -21,6 +21,10 @@ def _leakyrelu(x, alpha=0.01):
     return jnp.where(x >= 0, x, alpha * x)
 
 
+def _thresholdedrelu(x, theta=1.0):
+    return jnp.where(x > theta, x, 0.0)
+
+
 def _rationaltanh(x):
     # ND4J RationalTanh: 1.7159 * tanh_approx(2x/3) with Padé-style approx;
     # we use the exact form the approximation targets.
@@ -49,6 +53,7 @@ ACTIVATIONS = {
     "relu": jax.nn.relu,
     "relu6": lambda x: jnp.clip(x, 0.0, 6.0),
     "leakyrelu": _leakyrelu,
+    "thresholdedrelu": _thresholdedrelu,
     "elu": jax.nn.elu,
     "selu": jax.nn.selu,
     "gelu": jax.nn.gelu,

@@ -400,3 +400,77 @@ class Upsampling1D(Layer):
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         return jnp.repeat(x, self.size, axis=1), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class Cropping2D(Layer):
+    """Spatial cropping, the inverse of ZeroPaddingLayer (Keras Cropping2D
+    import target). ``cropping`` = (top, bottom, left, right) or (h, w)."""
+
+    cropping: Tuple[int, ...] = (0, 0)
+
+    def input_kind(self):
+        return "cnn"
+
+    def _crops(self):
+        c = self.cropping
+        if len(c) == 2:
+            return (c[0], c[0], c[1], c[1])
+        return tuple(int(v) for v in c)
+
+    def output_type(self, it: InputType) -> InputType:
+        t, b, l, r = self._crops()
+        h, w = it.height - t - b, it.width - l - r
+        if h <= 0 or w <= 0:
+            raise ValueError(f"Cropping {self.cropping} consumes the whole "
+                             f"{it.height}x{it.width} input")
+        return InputType.convolutional(h, w, it.channels)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        t, b, l, r = self._crops()
+        return x[:, t:x.shape[1] - b, l:x.shape[2] - r, :], state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class Cropping1D(Layer):
+    """Temporal cropping (Keras Cropping1D). ``cropping`` = (left, right)."""
+
+    cropping: Tuple[int, int] = (0, 0)
+
+    def input_kind(self):
+        return "cnn1d"
+
+    def output_type(self, it: InputType) -> InputType:
+        l, r = self.cropping
+        t = None if it.timeseries_length is None else it.timeseries_length - l - r
+        if t is not None and t <= 0:
+            raise ValueError(f"Cropping {self.cropping} consumes the whole "
+                             f"length-{it.timeseries_length} sequence")
+        return InputType.recurrent(it.size, t)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        l, r = self.cropping
+        return x[:, l:x.shape[1] - r, :], state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class ZeroPadding1DLayer(Layer):
+    """Temporal zero padding (Keras ZeroPadding1D; reference
+    ZERO_PADDING_1D in KerasLayerConfiguration)."""
+
+    padding: Tuple[int, int] = (1, 1)
+
+    def input_kind(self):
+        return "cnn1d"
+
+    def output_type(self, it: InputType) -> InputType:
+        l, r = self.padding
+        t = None if it.timeseries_length is None else it.timeseries_length + l + r
+        return InputType.recurrent(it.size, t)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        l, r = self.padding
+        return jnp.pad(x, ((0, 0), (l, r), (0, 0))), state

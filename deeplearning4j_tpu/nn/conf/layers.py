@@ -321,12 +321,57 @@ class DenseLayer(BaseLayer):
 @register_layer
 @dataclasses.dataclass(frozen=True)
 class ActivationLayer(Layer):
-    """Pure activation (reference nn/conf/layers/ActivationLayer.java)."""
+    """Pure activation (reference nn/conf/layers/ActivationLayer.java).
+    ``activation_param`` feeds parameterized activations (LeakyReLU alpha,
+    ELU alpha, ThresholdedReLU theta — the Keras advanced-activation layer
+    classes lower to this)."""
 
     activation: str = "relu"
+    activation_param: Optional[float] = None
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
-        return get_activation(self.activation)(x), state
+        fn = get_activation(self.activation)
+        if self.activation_param is not None:
+            return fn(x, self.activation_param), state
+        return fn(x), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class PReLULayer(BaseLayer):
+    """Parametric ReLU with learnable negative slope (reference
+    nn/conf/layers/PReLULayer — Keras advanced_activations.PReLU).
+    ``shared_axes`` lists 1-based input axes sharing one alpha (Keras
+    convention: shared_axes=[1, 2] gives per-channel alpha on NHWC)."""
+
+    shared_axes: Optional[Tuple[int, ...]] = None
+
+    def input_kind(self):
+        return "any"
+
+    def output_type(self, input_type):
+        return input_type
+
+    def _alpha_shape(self, input_type):
+        if input_type.kind == "cnn":
+            shape = [input_type.height, input_type.width, input_type.channels]
+        elif input_type.kind in ("rnn", "cnn1d"):
+            shape = [input_type.timeseries_length or 1, input_type.size]
+        else:
+            shape = [input_type.flat_size()]
+        for ax in self.shared_axes or ():
+            shape[ax - 1] = 1
+        return tuple(shape)
+
+    def init(self, rng, input_type, dtype=jnp.float32):
+        return {"alpha": jnp.zeros(self._alpha_shape(input_type), dtype)}, {}
+
+    def regularizable(self):
+        return ()
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        alpha = params["alpha"]
+        return jnp.where(x >= 0, x, alpha * x), state
 
 
 @register_layer

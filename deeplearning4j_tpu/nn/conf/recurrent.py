@@ -417,3 +417,138 @@ class LastTimeStep(BaseRecurrentLayer):
         if self.layer is not None and getattr(self.layer, "n_in", 0) in (None, 0):
             return dataclasses.replace(self, layer=self.layer.with_n_in(n_in))
         return self
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class SimpleRnn(BaseRecurrentLayer):
+    """Vanilla recurrent layer h_t = act(x_t W + h_{t-1} U + b)
+    (reference nn/conf/layers — Keras SimpleRNN import target). Input
+    projection is hoisted into one MXU matmul over all timesteps, like LSTM."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    activation: str = "tanh"
+
+    def regularizable(self):
+        return ("W", "U")
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, it.timeseries_length)
+
+    def init(self, rng, it: InputType, dtype=jnp.float32):
+        n_in = self.n_in or it.size
+        k1, k2 = jax.random.split(rng)
+        return {
+            "W": init_weights(k1, (n_in, self.n_out), n_in, self.n_out,
+                              self.weight_init, self.dist, dtype),
+            "U": init_weights(k2, (self.n_out, self.n_out), self.n_out,
+                              self.n_out, self.weight_init, self.dist, dtype),
+            "b": jnp.zeros((self.n_out,), dtype),
+        }, {}
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        return {"h": jnp.zeros((batch, self.n_out), dtype)}
+
+    def apply_seq(self, params, carry, x, *, train=False, rng=None, mask=None):
+        x = dropout_input(x, self.dropout, train, rng)
+        b, t, _ = x.shape
+        act = get_activation(self.activation)
+        xw = (x.reshape(b * t, -1) @ params["W"] + params["b"]).reshape(b, t, -1)
+        xw_t = jnp.swapaxes(xw, 0, 1)
+        m_t = None if mask is None else jnp.swapaxes(mask, 0, 1)
+        U = params["U"]
+
+        def step(c, inp):
+            xw_i, m_i = inp if m_t is not None else (inp, None)
+            h_prev = c["h"]
+            h = act(xw_i + h_prev @ U)
+            if m_i is not None:
+                keep = m_i[:, None]
+                h = keep * h + (1.0 - keep) * h_prev
+                out = keep * h
+            else:
+                out = h
+            return {"h": h}, out
+
+        xs = xw_t if m_t is None else (xw_t, m_t)
+        new_carry, outs = lax.scan(step, carry, xs)
+        return jnp.swapaxes(outs, 0, 1), new_carry
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class GRU(BaseRecurrentLayer):
+    """Gated recurrent unit (Keras GRU import target; gate order z, r, h).
+
+    ``reset_after=False`` (classic): hh = act(xWh + (r*h)Uh + bh).
+    ``reset_after=True`` (CuDNN-compatible Keras 2.x default): separate
+    input/recurrent biases, hh = act(xWh + bh + r*(hUh + bhr)); params then
+    carry "br" with the recurrent half."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    activation: str = "tanh"
+    gate_activation: str = "sigmoid"
+    reset_after: bool = False
+
+    def regularizable(self):
+        return ("W", "U")
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, it.timeseries_length)
+
+    def init(self, rng, it: InputType, dtype=jnp.float32):
+        n_in = self.n_in or it.size
+        n = self.n_out
+        k1, k2 = jax.random.split(rng)
+        params = {
+            "W": init_weights(k1, (n_in, 3 * n), n_in, n, self.weight_init,
+                              self.dist, dtype),
+            "U": init_weights(k2, (n, 3 * n), n, n, self.weight_init,
+                              self.dist, dtype),
+            "b": jnp.zeros((3 * n,), dtype),
+        }
+        if self.reset_after:
+            params["br"] = jnp.zeros((3 * n,), dtype)
+        return params, {}
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        return {"h": jnp.zeros((batch, self.n_out), dtype)}
+
+    def apply_seq(self, params, carry, x, *, train=False, rng=None, mask=None):
+        x = dropout_input(x, self.dropout, train, rng)
+        b, t, _ = x.shape
+        n = self.n_out
+        act = get_activation(self.activation)
+        gate = get_activation(self.gate_activation)
+        xw = (x.reshape(b * t, -1) @ params["W"] + params["b"]).reshape(b, t, -1)
+        xw_t = jnp.swapaxes(xw, 0, 1)
+        m_t = None if mask is None else jnp.swapaxes(mask, 0, 1)
+        U = params["U"]
+        br = params.get("br")
+
+        def step(c, inp):
+            xw_i, m_i = inp if m_t is not None else (inp, None)
+            h_prev = c["h"]
+            if self.reset_after:
+                hu = h_prev @ U + br
+                z = gate(xw_i[:, :n] + hu[:, :n])
+                r = gate(xw_i[:, n:2 * n] + hu[:, n:2 * n])
+                hh = act(xw_i[:, 2 * n:] + r * hu[:, 2 * n:])
+            else:
+                z = gate(xw_i[:, :n] + h_prev @ U[:, :n])
+                r = gate(xw_i[:, n:2 * n] + h_prev @ U[:, n:2 * n])
+                hh = act(xw_i[:, 2 * n:] + (r * h_prev) @ U[:, 2 * n:])
+            h = z * h_prev + (1.0 - z) * hh
+            if m_i is not None:
+                keep = m_i[:, None]
+                h = keep * h + (1.0 - keep) * h_prev
+                out = keep * h
+            else:
+                out = h
+            return {"h": h}, out
+
+        xs = xw_t if m_t is None else (xw_t, m_t)
+        new_carry, outs = lax.scan(step, carry, xs)
+        return jnp.swapaxes(outs, 0, 1), new_carry

@@ -34,6 +34,23 @@ from deeplearning4j_tpu.parallel.mesh import (
 )
 
 
+class _EpochHooksSuppressed:
+    """Listener proxy forwarding everything but epoch hooks (used when a
+    minibatch is routed through model.fit, which counts a full epoch)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def on_epoch_start(self, model):
+        pass
+
+    def on_epoch_end(self, model):
+        pass
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
 class ParallelWrapper:
     """Data-parallel (optionally tensor-parallel) training wrapper.
 
@@ -130,7 +147,17 @@ class ParallelWrapper:
             else:
                 m._fit_batch(m._get_jitted("train"), sharded)
         else:
-            m.fit(sharded)
+            # tbptt/solver configs go through model.fit; suppress its
+            # per-call epoch side effects (hooks + epoch counter) so the
+            # wrapper's once-per-epoch semantics hold for every config
+            saved_listeners = m.listeners
+            epoch0 = m.epoch
+            m.listeners = [_EpochHooksSuppressed(l) for l in saved_listeners]
+            try:
+                m.fit(sharded)
+            finally:
+                m.listeners = saved_listeners
+                m.epoch = epoch0
 
     def fit_batch(self, ds: DataSet, drop_ragged: bool = False) -> bool:
         """Train on ONE global batch (sharded over the mesh); returns whether
@@ -167,12 +194,18 @@ class ParallelWrapper:
         for _ in range(num_epochs):
             for listener in self.model.listeners:
                 listener.on_epoch_start(self.model)
-            trained = 0
+            trained = seen = 0
             for ds in data:
+                seen += 1
                 # a single explicit ragged DataSet raises (dropping it would
                 # train on nothing); iterator tail batches drop-remainder
                 if self.fit_batch(ds, drop_ragged=not explicit_single):
                     trained += 1
+            if seen == 0:
+                raise ValueError(
+                    "No batches this epoch — the data iterable is empty or a "
+                    "one-shot generator exhausted by a previous epoch; pass a "
+                    "re-iterable DataSetIterator")
             if trained == 0:
                 raise ValueError(
                     "Every batch this epoch was dropped as ragged — the "
@@ -271,6 +304,7 @@ class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
         self.wrapper = ParallelWrapper(model, mesh=mesh,
                                        tensor_parallel=tensor_parallel)
 
-    def _fit_batch(self, ds):
+    def _fit_batch(self, ds) -> bool:
         # per-batch path: no epoch-listener double fire, ragged tails dropped
-        self.wrapper.fit_batch(ds, drop_ragged=True)
+        # (the base trainer raises if an entire epoch trains nothing)
+        return self.wrapper.fit_batch(ds, drop_ragged=True)

@@ -200,3 +200,77 @@ class TestFunctionalImport:
         net = import_keras_model(path)
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
         assert isinstance(net, MultiLayerNetwork)
+
+
+class TestExpandedConverterSet:
+    """Round-3 converter additions: GRU/SimpleRNN, advanced activations,
+    Cropping, ZeroPadding1D (beyond the reference's converter table)."""
+
+    def test_gru_and_simplernn(self, tmp_path):
+        rng = np.random.default_rng(4)
+        m = keras.Sequential([
+            keras.layers.Input((6, 5)),
+            keras.layers.GRU(8, return_sequences=True, reset_after=True),
+            keras.layers.SimpleRNN(7, return_sequences=False),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        path = _save(m, tmp_path, "gru.h5", loss="categorical_crossentropy")
+        net = import_keras_sequential_model_and_weights(path)
+        x = rng.standard_normal((4, 6, 5)).astype(np.float32)
+        np.testing.assert_allclose(net.output(x), np.asarray(m(x)), atol=1e-5)
+
+    def test_gru_classic_gates(self, tmp_path):
+        rng = np.random.default_rng(5)
+        m = keras.Sequential([
+            keras.layers.Input((5, 4)),
+            keras.layers.GRU(6, reset_after=False),
+            keras.layers.Dense(2),
+        ])
+        path = _save(m, tmp_path, "gru2.h5", loss="mse")
+        net = import_keras_sequential_model_and_weights(path)
+        x = rng.standard_normal((3, 5, 4)).astype(np.float32)
+        np.testing.assert_allclose(net.output(x), np.asarray(m(x)), atol=1e-5)
+
+    def test_advanced_activations(self, tmp_path):
+        rng = np.random.default_rng(6)
+        m = keras.Sequential([
+            keras.layers.Input((10,)),
+            keras.layers.Dense(8),
+            keras.layers.LeakyReLU(negative_slope=0.2),
+            keras.layers.Dense(8),
+            keras.layers.PReLU(),
+            keras.layers.Dense(4),
+            keras.layers.ELU(alpha=0.7),
+            keras.layers.Dense(2),
+        ])
+        path = _save(m, tmp_path, "adv.h5", loss="mse")
+        net = import_keras_sequential_model_and_weights(path)
+        x = rng.standard_normal((6, 10)).astype(np.float32)
+        np.testing.assert_allclose(net.output(x), np.asarray(m(x)), atol=1e-5)
+
+    def test_cropping_and_padding(self, tmp_path):
+        rng = np.random.default_rng(7)
+        m = keras.Sequential([
+            keras.layers.Input((10, 10, 2)),
+            keras.layers.Cropping2D(((1, 2), (2, 1))),
+            keras.layers.Conv2D(3, (3, 3), activation="relu"),
+            keras.layers.Flatten(),
+            keras.layers.Dense(2),
+        ])
+        path = _save(m, tmp_path, "crop.h5", loss="mse")
+        net = import_keras_sequential_model_and_weights(path)
+        x = rng.standard_normal((2, 10, 10, 2)).astype(np.float32)
+        np.testing.assert_allclose(net.output(x), np.asarray(m(x)), atol=1e-5)
+
+    def test_vgg16_preprocessor(self):
+        from deeplearning4j_tpu.modelimport.trainedmodels import (
+            TrainedModels, VGG16ImagePreProcessor, VGG_MEAN_RGB)
+        pre = TrainedModels.get_pre_processor("VGG16")
+        assert isinstance(pre, VGG16ImagePreProcessor)
+        x = np.full((1, 2, 2, 3), 128.0, np.float32)
+        out = pre.preprocess_features(x)
+        # channel 0 of output is BGR's blue = 128 - mean_blue
+        assert out[0, 0, 0, 0] == pytest.approx(128.0 - VGG_MEAN_RGB[2])
+        assert out[0, 0, 0, 2] == pytest.approx(128.0 - VGG_MEAN_RGB[0])
+        with pytest.raises(ValueError):
+            TrainedModels.get_pre_processor("resnet")

@@ -140,3 +140,22 @@ def test_cli_bad_data_spec(tmp_path):
     csv.write_text("\n".join(f"1.0,2.0,{i % 2}" for i in range(8)))
     it = build_iterator(f"csv:{csv}:2:2", 4)
     assert next(iter(it)).labels.shape == (4, 2)
+
+
+def test_early_stopping_all_ragged_raises(devices):
+    ds = _iris()
+    bad = DataSet(ds.features[:50], ds.labels[:50])  # 50 % 8 != 0
+    config = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(2)])
+    trainer = EarlyStoppingParallelTrainer(
+        config, _net(), train_data=[bad], validation_data=[ds],
+        mesh=make_mesh())
+    with pytest.raises(ValueError, match="usable"):
+        trainer.fit()
+
+
+def test_wrapper_exhausted_generator_message(devices):
+    ds = _iris()
+    gen = (b for b in [DataSet(ds.features[:48], ds.labels[:48])])
+    with pytest.raises(ValueError, match="re-iterable"):
+        ParallelWrapper(_net(), mesh=make_mesh()).fit(gen, num_epochs=2)

@@ -67,3 +67,13 @@ def test_stop_words():
     v = TfidfVectorizer(stop_words=("the", "and"))
     v.fit(DOCS)
     assert not v.vocab.contains_word("the")
+
+
+def test_refit_replaces_corpus_stats():
+    v = TfidfVectorizer()
+    v.fit(["alpha beta", "alpha gamma"])
+    v.fit(DOCS)  # re-fit must not mix the first corpus in
+    assert v.total_docs == len(DOCS)
+    assert v.idf("alpha") == 0.0  # gone from stats entirely
+    import math
+    assert v.idf("fox") == pytest.approx(math.log10(4))
