@@ -14,5 +14,6 @@ distance matrix hits the MXU.
 from deeplearning4j_tpu.clustering.vptree import VPTree
 from deeplearning4j_tpu.clustering.kdtree import KDTree
 from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+from deeplearning4j_tpu.clustering.server import NearestNeighborsServer
 
-__all__ = ["VPTree", "KDTree", "KMeansClustering"]
+__all__ = ["VPTree", "KDTree", "KMeansClustering", "NearestNeighborsServer"]

@@ -1,0 +1,102 @@
+"""Nearest-neighbors HTTP server.
+
+Parity surface: reference
+``deeplearning4j-nearestneighbor-server/.../NearestNeighborsServer.java:44``
+(serve k-NN queries over a VPTree built from a points file; POST /knn with a
+vector + k, JSON results; /knnnew for vectors not in the index).
+
+stdlib ThreadingHTTPServer like the UI server (the reference uses Play).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.clustering.vptree import VPTree
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_ref = None  # type: Optional["NearestNeighborsServer"]
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        srv = type(self).server_ref
+        if self.path in ("/status", "/"):
+            self._json({"ok": True, "num_points": len(srv.points),
+                        "dims": int(srv.points.shape[1])})
+        else:
+            self._json({"error": "not found"}, 404)
+
+    def do_POST(self):
+        srv = type(self).server_ref
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length))
+        except Exception as e:
+            self._json({"error": f"bad request: {e}"}, 400)
+            return
+        k = int(req.get("k", 1))
+        if self.path == "/knn":
+            # query by index of an existing point (reference /knn contract)
+            idx = int(req.get("index", -1))
+            if not 0 <= idx < len(srv.points):
+                self._json({"error": f"index {idx} out of range"}, 400)
+                return
+            indices, dists = srv.tree.search(srv.points[idx], k + 1)
+            pairs = [(i, d) for i, d in zip(indices, dists) if i != idx][:k]
+        elif self.path == "/knnnew":
+            vec = np.asarray(req.get("ndarray", req.get("vector")), np.float64)
+            if vec.ndim != 1 or len(vec) != srv.points.shape[1]:
+                self._json({"error": "vector dims mismatch"}, 400)
+                return
+            indices, dists = srv.tree.search(vec, k)
+            pairs = list(zip(indices, dists))
+        else:
+            self._json({"error": "not found"}, 404)
+            return
+        self._json({"results": [
+            {"index": int(i), "distance": float(d),
+             **({"label": srv.labels[i]} if srv.labels else {})}
+            for i, d in pairs]})
+
+
+class NearestNeighborsServer:
+    """``NearestNeighborsServer(points).start(port)`` then POST /knn or
+    /knnnew (see module docstring)."""
+
+    def __init__(self, points, labels: Optional[Sequence[str]] = None,
+                 distance: str = "euclidean"):
+        self.points = np.asarray(points, np.float64)
+        if labels is not None and len(labels) != len(self.points):
+            raise ValueError("labels length must match points")
+        self.labels = list(labels) if labels is not None else None
+        self.tree = VPTree(self.points, distance=distance)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    def start(self, port: int = 9200) -> "NearestNeighborsServer":
+        handler = type("BoundNNHandler", (_Handler,), {"server_ref": self})
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

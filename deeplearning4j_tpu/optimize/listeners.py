@@ -165,3 +165,124 @@ class SleepyTrainingListener(TrainingListener):
     def iteration_done(self, model, iteration, epoch):
         if self.sleep_ms:
             time.sleep(self.sleep_ms / 1000.0)
+
+
+class ProfilerListener(TrainingListener):
+    """Capture an XLA/device profile for a window of training iterations
+    (TPU-native replacement for the reference's instrumentation hooks —
+    SURVEY §5 tracing/profiling: jax.profiler traces open in TensorBoard /
+    Perfetto and show per-op device time, HBM usage and fusion decisions).
+
+    Traces iterations [start_iteration, start_iteration + num_iterations).
+    """
+
+    def __init__(self, log_dir: str, start_iteration: int = 10,
+                 num_iterations: int = 5):
+        self.log_dir = log_dir
+        self.start_iteration = start_iteration
+        self.num_iterations = num_iterations
+        self._active = False
+        self.completed = False
+
+    def iteration_done(self, model, iteration, epoch):
+        import jax
+        if self.completed:
+            return
+        if not self._active and iteration >= self.start_iteration:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+            self._stop_at = iteration + self.num_iterations
+            return
+        if self._active and iteration >= self._stop_at:
+            # block so the traced window contains the real device work, not
+            # just async dispatch
+            jax.block_until_ready(model.params)
+            jax.profiler.stop_trace()
+            self._active = False
+            self.completed = True
+            log.info("Profiler trace written to %s", self.log_dir)
+
+    def close(self, model=None):
+        """Finalize a window left open because training ended inside it.
+        (Epoch boundaries deliberately do NOT stop the trace — a window may
+        span epochs.)"""
+        if self._active:
+            import jax
+            if model is not None:
+                jax.block_until_ready(model.params)
+            jax.profiler.stop_trace()
+            self._active = False
+            self.completed = True
+
+
+class CheckpointListener(TrainingListener):
+    """Periodic checkpointing with bounded retention + resume (reference
+    CheckpointListener semantics; the save format is
+    utils/serialization.write_model, which carries params, updater state and
+    iteration/epoch counters — restoring continues training where it
+    stopped, the SURVEY §5 checkpoint/resume + elasticity story).
+
+    ``every_n_iterations`` or ``every_n_epochs`` must be set; ``keep_last``
+    bounds disk use.
+    """
+
+    def __init__(self, checkpoint_dir: str, every_n_iterations: int = 0,
+                 every_n_epochs: int = 0, keep_last: int = 3,
+                 save_updater: bool = True):
+        if not every_n_iterations and not every_n_epochs:
+            raise ValueError("Set every_n_iterations or every_n_epochs")
+        import os
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        self.checkpoint_dir = checkpoint_dir
+        self.every_n_iterations = every_n_iterations
+        self.every_n_epochs = every_n_epochs
+        self.keep_last = keep_last
+        self.save_updater = save_updater
+        # adopt checkpoints from previous runs so keep_last bounds disk use
+        # across restore_last resume cycles, not just within one process
+        self.saved_paths: List[str] = sorted(
+            (os.path.join(checkpoint_dir, f)
+             for f in os.listdir(checkpoint_dir)
+             if f.startswith("checkpoint_") and f.endswith(".zip")),
+            key=os.path.getmtime)
+
+    def _save(self, model, tag: str):
+        import os
+        from deeplearning4j_tpu.utils.serialization import write_model
+        path = os.path.join(self.checkpoint_dir, f"checkpoint_{tag}.zip")
+        write_model(model, path, save_updater=self.save_updater)
+        self.saved_paths.append(path)
+        while len(self.saved_paths) > self.keep_last:
+            old = self.saved_paths.pop(0)
+            try:
+                os.remove(old)
+            except OSError:
+                pass
+
+    def iteration_done(self, model, iteration, epoch):
+        if self.every_n_iterations and iteration > 0 \
+                and iteration % self.every_n_iterations == 0:
+            self._save(model, f"iter_{iteration}")
+
+    def on_epoch_end(self, model):
+        if self.every_n_epochs and (model.epoch + 1) % self.every_n_epochs == 0:
+            self._save(model, f"epoch_{model.epoch}")
+
+    @staticmethod
+    def last_checkpoint(checkpoint_dir: str) -> Optional[str]:
+        """Most recent checkpoint path in a directory, or None."""
+        import os
+        files = [os.path.join(checkpoint_dir, f)
+                 for f in os.listdir(checkpoint_dir)
+                 if f.startswith("checkpoint_") and f.endswith(".zip")]
+        return max(files, key=os.path.getmtime) if files else None
+
+    @staticmethod
+    def restore_last(checkpoint_dir: str):
+        """Restore the most recent checkpoint (resume path). Raises if the
+        directory has none."""
+        from deeplearning4j_tpu.utils.serialization import restore
+        path = CheckpointListener.last_checkpoint(checkpoint_dir)
+        if path is None:
+            raise FileNotFoundError(f"No checkpoints in {checkpoint_dir}")
+        return restore(path)

@@ -1,0 +1,103 @@
+"""Remote stats routing: POST training stats to a (possibly remote) UI server.
+
+Parity surface: reference
+``deeplearning4j-core/.../api/storage/impl/RemoteUIStatsStorageRouter.java:32``
+(async posting to ``http://host:port/remoteReceive`` with bounded retries)
+and the Play server's remote-receiver route. The receiving side is
+``ui/server.py``'s ``POST /remoteReceive`` endpoint feeding the attached
+storage.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import urllib.request
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+DEFAULT_PATH = "remoteReceive"
+
+
+class RemoteUIStatsStorageRouter:
+    """Same write surface as a StatsStorage (put_static_info/put_update) but
+    records travel over HTTP to a UI server process — use it as the
+    ``storage`` of a StatsListener on training workers."""
+
+    _END = object()
+
+    def __init__(self, url: str, max_retries: int = 10,
+                 retry_backoff_s: float = 0.5, queue_size: int = 256):
+        self.base = url.rstrip("/")
+        if not self.base.endswith("/" + DEFAULT_PATH):
+            self.base = f"{self.base}/{DEFAULT_PATH}"
+        self.max_retries = max_retries
+        self.retry_backoff_s = retry_backoff_s
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self._shutdown = False
+        self._failures = 0
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    # ----------------------------------------------------------- router API
+    def put_static_info(self, record: dict):
+        self._enqueue(record)
+
+    def put_update(self, record: dict):
+        self._enqueue(record)
+
+    def _enqueue(self, record: dict):
+        if self._shutdown:
+            raise RuntimeError("Router is shut down")
+        try:
+            self._q.put_nowait(record)
+        except queue.Full:
+            log.warning("RemoteUIStatsStorageRouter queue full; dropping a "
+                        "stats record")
+
+    def shutdown(self, timeout: float = 10.0) -> bool:
+        """Stop the posting thread, attempting to flush first. During
+        shutdown each remaining record gets ONE quick post attempt (2s
+        timeout) instead of the full retry budget. Returns True when every
+        queued record was delivered; False if records were dropped."""
+        self._shutdown = True
+        self._q.put(self._END)
+        self._thread.join(timeout)
+        flushed = self._q.empty() and not self._thread.is_alive()
+        if not flushed:
+            log.warning("RemoteUIStatsStorageRouter shutdown before the "
+                        "queue drained; undelivered stats records dropped")
+        return flushed
+
+    # --------------------------------------------------------------- worker
+    def _worker(self):
+        import time
+        while True:
+            item = self._q.get()
+            if item is self._END:
+                return
+            body = json.dumps(item).encode("utf-8")
+            # draining during shutdown: one quick attempt per record so the
+            # caller's join() window actually bounds the flush
+            retries = 1 if self._shutdown else self.max_retries
+            req_timeout = 2 if self._shutdown else 10
+            for attempt in range(retries):
+                try:
+                    req = urllib.request.Request(
+                        self.base, data=body,
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=req_timeout) as resp:
+                        resp.read()
+                    self._failures = 0
+                    break
+                except Exception as e:
+                    self._failures += 1
+                    if attempt == retries - 1:
+                        log.warning("Dropping stats record after %d failed "
+                                    "posts to %s (%s)", retries,
+                                    self.base, e)
+                    else:
+                        time.sleep(self.retry_backoff_s * (attempt + 1))

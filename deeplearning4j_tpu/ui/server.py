@@ -269,6 +269,25 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send(404, b"not found", "text/plain")
 
+    def do_POST(self):
+        """Remote stats receiver (reference PlayUIServer remote-receiver
+        route; fed by storage.remote.RemoteUIStatsStorageRouter)."""
+        url = urlparse(self.path)
+        st = type(self).storage
+        if url.path not in ("/remoteReceive", "/remoteReceive/") or st is None:
+            self._send(404, b"not found", "text/plain")
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            record = json.loads(self.rfile.read(length))
+            if record.get("kind") == "static":
+                st.put_static_info(record)
+            else:
+                st.put_update(record)
+            self._json({"ok": True})
+        except Exception as e:
+            self._send(400, f"bad record: {e}".encode(), "text/plain")
+
 
 class UIServer:
     """Singleton UI server (reference UIServer.getInstance() /

@@ -1,0 +1,189 @@
+"""Aux completion tests: Viterbi, ArchiveUtils, remote stats routing,
+profiler + checkpoint listeners, nearest-neighbors server.
+
+Mirrors the reference's ViterbiTest, ArchiveUtils usage in fetchers,
+RemoteUIStatsStorageRouter + remote-receiver route, CheckpointListener
+semantics, and NearestNeighborsServerTest."""
+
+import json
+import os
+import time
+import urllib.request
+import zipfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import NearestNeighborsServer
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import (CheckpointListener,
+                                                   ProfilerListener)
+from deeplearning4j_tpu.optimize.updaters import Adam
+from deeplearning4j_tpu.storage import InMemoryStatsStorage
+from deeplearning4j_tpu.storage.remote import RemoteUIStatsStorageRouter
+from deeplearning4j_tpu.ui import StatsListener, UIServer
+from deeplearning4j_tpu.utils.archive import unzip_file_to
+from deeplearning4j_tpu.utils.viterbi import Viterbi
+
+
+def _net(seed=5):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Adam(learning_rate=0.05))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _toy(n=24, seed=0):
+    rng = np.random.default_rng(seed)
+    return DataSet(rng.standard_normal((n, 4)).astype(np.float32),
+                   np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)])
+
+
+# ---------------------------------------------------------------- viterbi
+def test_viterbi_smooths_flickers():
+    v = Viterbi([0, 1], meta_stability=0.95, p_correct=0.8)
+    # long stable runs with single-frame flickers
+    noisy = [0] * 10 + [1] + [0] * 10 + [1] * 10 + [0] + [1] * 10
+    ll, decoded = v.decode(np.asarray(noisy), binary_label_matrix=False)
+    want = [0] * 21 + [1] * 21
+    assert decoded.tolist() == want
+    assert np.isfinite(ll)
+    # one-hot input form
+    onehot = np.eye(2)[noisy]
+    _, decoded2 = v.decode(onehot)
+    assert decoded2.tolist() == want
+
+
+def test_viterbi_respects_strong_emissions():
+    v = Viterbi(["a", "b"], meta_stability=0.6, p_correct=0.999)
+    _, decoded = v.decode(np.asarray([0, 1, 0, 1]), binary_label_matrix=False)
+    assert decoded.tolist() == ["a", "b", "a", "b"]
+
+
+# ----------------------------------------------------------------- archive
+def test_unzip_file_to(tmp_path):
+    src = tmp_path / "a.zip"
+    with zipfile.ZipFile(src, "w") as z:
+        z.writestr("x/data.txt", "hello")
+    out = tmp_path / "out"
+    unzip_file_to(str(src), str(out))
+    assert (out / "x" / "data.txt").read_text() == "hello"
+    # zip-slip rejected
+    evil = tmp_path / "evil.zip"
+    with zipfile.ZipFile(evil, "w") as z:
+        z.writestr("../escape.txt", "nope")
+    with pytest.raises(ValueError, match="escapes"):
+        unzip_file_to(str(evil), str(out))
+
+
+# ------------------------------------------------------------ remote stats
+def test_remote_stats_router_roundtrip():
+    storage = InMemoryStatsStorage()
+    server = UIServer(port=0).attach(storage)
+    try:
+        router = RemoteUIStatsStorageRouter(f"http://localhost:{server.port}")
+        net = _net()
+        net.set_listeners(StatsListener(router, session_id="remote-sess",
+                                        worker_id="w1"))
+        net.fit(_toy())
+        router.shutdown()
+        assert storage.list_session_ids() == ["remote-sess"]
+        assert storage.num_update_records("remote-sess", "StatsListener") == 1
+        static = storage.get_static_info("remote-sess", "StatsListener")
+        assert static["model"]["class"] == "MultiLayerNetwork"
+    finally:
+        server.stop()
+
+
+# -------------------------------------------------------------- checkpoint
+def test_checkpoint_listener_retention_and_resume(tmp_path):
+    cdir = str(tmp_path / "ckpts")
+    net = _net()
+    net.set_listeners(CheckpointListener(cdir, every_n_iterations=2,
+                                         keep_last=2))
+    ds = _toy()
+    for _ in range(7):
+        net.fit(ds)
+    files = sorted(os.listdir(cdir))
+    assert len(files) == 2  # retention bound
+    resumed = CheckpointListener.restore_last(cdir)
+    # last save fired at iteration 6 (saves at 2, 4, 6; keep_last=2 -> 4, 6)
+    assert resumed.iteration == 6
+    # resume continues training from saved counters
+    it0 = resumed.iteration
+    resumed.fit(ds)
+    assert resumed.iteration == it0 + 1
+    assert np.isfinite(resumed.score())
+
+
+def test_checkpoint_requires_frequency(tmp_path):
+    with pytest.raises(ValueError):
+        CheckpointListener(str(tmp_path))
+
+
+def test_checkpoint_retention_across_resume(tmp_path):
+    cdir = str(tmp_path / "ck")
+    ds = _toy()
+    net = _net()
+    net.set_listeners(CheckpointListener(cdir, every_n_iterations=2,
+                                         keep_last=2))
+    for _ in range(5):
+        net.fit(ds)
+    # simulated restart: a fresh listener must adopt the old files so
+    # keep_last keeps bounding disk use
+    resumed = CheckpointListener.restore_last(cdir)
+    resumed.set_listeners(CheckpointListener(cdir, every_n_iterations=2,
+                                             keep_last=2))
+    for _ in range(6):
+        resumed.fit(ds)
+    assert len(os.listdir(cdir)) == 2
+
+
+# ---------------------------------------------------------------- profiler
+def test_profiler_listener(tmp_path):
+    log_dir = str(tmp_path / "prof")
+    net = _net()
+    net.set_listeners(ProfilerListener(log_dir, start_iteration=2,
+                                       num_iterations=2))
+    ds = _toy()
+    for _ in range(6):
+        net.fit(ds)
+    listener = net.listeners[0]
+    assert listener.completed
+    # a trace directory with at least one file appeared
+    found = [os.path.join(r, f) for r, _, fs in os.walk(log_dir) for f in fs]
+    assert found, "no profiler trace written"
+
+
+# ---------------------------------------------------------------- nn server
+def test_nearest_neighbors_server():
+    rng = np.random.default_rng(2)
+    pts = np.concatenate([rng.standard_normal((20, 3)),
+                          rng.standard_normal((20, 3)) + 10])
+    labels = ["a"] * 20 + ["b"] * 20
+    srv = NearestNeighborsServer(pts, labels=labels).start(port=0)
+    try:
+        base = f"http://localhost:{srv.port}"
+        status = json.loads(urllib.request.urlopen(base + "/status").read())
+        assert status["num_points"] == 40 and status["dims"] == 3
+        req = urllib.request.Request(
+            base + "/knn", data=json.dumps({"index": 0, "k": 3}).encode(),
+            headers={"Content-Type": "application/json"})
+        res = json.loads(urllib.request.urlopen(req).read())["results"]
+        assert len(res) == 3 and all(r["label"] == "a" for r in res)
+        assert all(r["index"] != 0 for r in res)  # self excluded
+        req2 = urllib.request.Request(
+            base + "/knnnew",
+            data=json.dumps({"ndarray": [10.0, 10.0, 10.0], "k": 2}).encode(),
+            headers={"Content-Type": "application/json"})
+        res2 = json.loads(urllib.request.urlopen(req2).read())["results"]
+        assert all(r["label"] == "b" for r in res2)
+    finally:
+        srv.stop()
