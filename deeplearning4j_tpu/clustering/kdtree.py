@@ -42,6 +42,8 @@ class KDTree:
 
     def search(self, target, k: int) -> Tuple[List[int], List[float]]:
         """k nearest indices + euclidean distances, ascending."""
+        if k < 1:
+            raise ValueError(f"k must be >= 1; got {k}")
         target = np.asarray(target, np.float64)
         heap: List[Tuple[float, int]] = []
 

@@ -47,27 +47,32 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             length = int(self.headers.get("Content-Length", 0))
             req = json.loads(self.rfile.read(length))
-        except Exception as e:
+            k = int(req.get("k", 1))
+            if k < 1:
+                self._json({"error": f"k must be >= 1; got {k}"}, 400)
+                return
+            if self.path == "/knn":
+                # query by index of an existing point (reference /knn contract)
+                idx = int(req.get("index", -1))
+                if not 0 <= idx < len(srv.points):
+                    self._json({"error": f"index {idx} out of range"}, 400)
+                    return
+                indices, dists = srv.tree.search(srv.points[idx], k + 1)
+                pairs = [(i, d) for i, d in zip(indices, dists)
+                         if i != idx][:k]
+            elif self.path == "/knnnew":
+                vec = np.asarray(req.get("ndarray", req.get("vector")),
+                                 np.float64)
+                if vec.ndim != 1 or len(vec) != srv.points.shape[1]:
+                    self._json({"error": "vector dims mismatch"}, 400)
+                    return
+                indices, dists = srv.tree.search(vec, k)
+                pairs = list(zip(indices, dists))
+            else:
+                self._json({"error": "not found"}, 404)
+                return
+        except Exception as e:  # malformed request -> 400, never a dead thread
             self._json({"error": f"bad request: {e}"}, 400)
-            return
-        k = int(req.get("k", 1))
-        if self.path == "/knn":
-            # query by index of an existing point (reference /knn contract)
-            idx = int(req.get("index", -1))
-            if not 0 <= idx < len(srv.points):
-                self._json({"error": f"index {idx} out of range"}, 400)
-                return
-            indices, dists = srv.tree.search(srv.points[idx], k + 1)
-            pairs = [(i, d) for i, d in zip(indices, dists) if i != idx][:k]
-        elif self.path == "/knnnew":
-            vec = np.asarray(req.get("ndarray", req.get("vector")), np.float64)
-            if vec.ndim != 1 or len(vec) != srv.points.shape[1]:
-                self._json({"error": "vector dims mismatch"}, 400)
-                return
-            indices, dists = srv.tree.search(vec, k)
-            pairs = list(zip(indices, dists))
-        else:
-            self._json({"error": "not found"}, 404)
             return
         self._json({"results": [
             {"index": int(i), "distance": float(d),

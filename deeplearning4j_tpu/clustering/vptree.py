@@ -96,6 +96,8 @@ class VPTree:
     def search(self, target, k: int) -> Tuple[List[int], List[float]]:
         """k nearest item indices + distances, ascending (reference
         VPTree.search)."""
+        if k < 1:
+            raise ValueError(f"k must be >= 1; got {k}")
         target = np.asarray(target, np.float64)
         heap: List[Tuple[float, int]] = []  # max-heap via negated distance
         tau = [np.inf]

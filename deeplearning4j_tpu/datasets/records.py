@@ -79,6 +79,25 @@ class CSVRecordReader(RecordReader):
                 continue
             yield [self._parse(v) for v in row]
 
+    def numeric_matrix(self) -> Optional[np.ndarray]:
+        """All-numeric fast path: the native one-pass parser
+        (deeplearning4j_tpu.native.parse_csv_numeric) turns the whole source
+        into a float32 matrix without per-row Python objects. None when the
+        native lib is absent or the data has strings/ragged rows — callers
+        fall back to row iteration."""
+        from deeplearning4j_tpu.native import parse_csv_numeric
+        if not isinstance(self.source, str):
+            # a generator/file-object source may be one-shot: consuming it
+            # here would leave the fallback row path empty, so the fast path
+            # only applies to path/string sources
+            return None
+        if os.path.exists(self.source):
+            with open(self.source, "rb") as f:
+                data = f.read()
+        else:
+            data = self.source.encode("utf-8")
+        return parse_csv_numeric(data, self.delimiter, self.skip_lines)
+
     @staticmethod
     def _parse(v: str):
         v = v.strip()
@@ -170,7 +189,7 @@ class RecordReaderDataSetIterator(DataSetIterator):
 
     def _split(self, rows: List[list]):
         li = self.label_index
-        if (not self.regression and li >= 0 and rows
+        if (not self.regression and li >= 0 and len(rows)
                 and isinstance(rows[0][li], str)):
             # auto-map string class labels to stable indices in order of
             # first appearance (the common 'species name' CSV case)
@@ -202,6 +221,15 @@ class RecordReaderDataSetIterator(DataSetIterator):
         return DataSet(feats, labels.astype(np.float32))
 
     def _generate(self):
+        # native bulk path: one C++ pass over the bytes, then pure slicing
+        mat = (self.reader.numeric_matrix()
+               if hasattr(self.reader, "numeric_matrix") else None)
+        if mat is not None:
+            for k, s in enumerate(range(0, len(mat), self._batch)):
+                if 0 < self.max_num_batches <= k:
+                    return
+                yield self._split(mat[s:s + self._batch])
+            return
         rows, batches = [], 0
         for rec in self.reader:
             rows.append(rec)

@@ -24,22 +24,39 @@ stable."""
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 
 _EPS = 1e-7
 
+# TPU scatters serialize row-by-row (profiled ~13x slower than expressing
+# the same segment-sum as a one-hot matmul on the MXU). The matmul path
+# materializes a transient (N, V) bf16 one-hot, so it is gated on memory;
+# above the budget (huge vocab x batch) the scatter path remains.
+_ONEHOT_BYTES_LIMIT = int(os.environ.get("DL4J_TPU_ONEHOT_SCATTER_BYTES",
+                                         2 * 1024**3))
+
 
 def _scatter_mean_update(table, idx, grads, weights, lr):
     """table += lr * segment_mean(grads over idx).
 
     idx (N,) int32 destination rows, grads (N, D), weights (N,) 0/1 validity.
-    Rows untouched in this batch keep count 0 and receive no update. Cost is
-    O(N*D) — only a (V,) count vector is materialized, never a (V, D)
-    accumulator, so the per-batch work stays proportional to the batch."""
-    cnt = jnp.zeros((table.shape[0],), table.dtype).at[idx].add(weights)
+    Rows untouched in this batch keep count 0 and receive no update. The
+    count vector is a cheap scalar scatter; the (V, D) accumulation uses the
+    one-hot-matmul MXU path when the transient one-hot fits the budget."""
+    V = table.shape[0]
+    n = idx.shape[0]
+    cnt = jnp.zeros((V,), table.dtype).at[idx].add(weights)
     scale = (weights / jnp.maximum(cnt, 1.0)[idx])[:, None]
+    # the matmul rewrite only pays where scatters are slow (TPU); CPU keeps
+    # the exact fp32 scatter (cheap there, and no bf16 rounding)
+    if (jax.default_backend() == "tpu"
+            and n * V * 2 <= _ONEHOT_BYTES_LIMIT):
+        oh = jax.nn.one_hot(idx, V, dtype=jnp.bfloat16)
+        upd = jnp.matmul(oh.T, (grads * scale).astype(jnp.bfloat16))
+        return table + lr * upd.astype(table.dtype)
     return table.at[idx].add(lr * grads * scale)
 
 
@@ -248,3 +265,35 @@ def glove_step(w, wc, b, bc, gw, gwc, gb, gbc, rows, cols, logx, weight, lr):
     b = b.at[rows].add(-lr * fdiff / jnp.sqrt(gb[rows] + _EPS))
     bc = bc.at[cols].add(-lr * fdiff / jnp.sqrt(gbc[cols] + _EPS))
     return w, wc, b, bc, gw, gwc, gb, gbc, loss
+
+
+# ---------------------------------------------------------------------------
+# Whole-chunk scanned steps: ONE dispatch for a stack of (num_batches, B)
+# slices. NOT used by the SequenceVectors training loops — measured on the
+# v5e tunnel, per-batch dispatch wins because it overlaps host pair/negative
+# prep with device compute, while the scan serializes them. Kept as a
+# parity-tested alternative for environments where dispatch latency
+# dominates (e.g. extreme RPC latency and precomputed batches). The
+# underlying (unjitted) step bodies are reused via .__wrapped__ so the math
+# stays defined once.
+
+def _scanned(step_fn, num_tables=2):
+    def scan_fn(*args):
+        tables = args[:num_tables]
+        batches = args[num_tables:-1]
+        lr = args[-1]
+
+        def body(carry, inp):
+            out = step_fn(*carry, *inp, lr)
+            return out[:num_tables], out[num_tables]
+
+        tables, losses = jax.lax.scan(body, tables, batches)
+        return (*tables, losses)
+
+    return functools.partial(jax.jit, donate_argnums=tuple(range(num_tables)))(scan_fn)
+
+
+sgns_scan = _scanned(sgns_step.__wrapped__)
+hs_scan = _scanned(hs_step.__wrapped__)
+cbow_scan = _scanned(cbow_step.__wrapped__)
+cbow_hs_scan = _scanned(cbow_hs_step.__wrapped__)

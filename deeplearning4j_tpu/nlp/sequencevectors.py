@@ -250,7 +250,11 @@ class SequenceVectors:
             # one value (per-batch float() would serialize the dispatch queue)
             if epoch_losses:
                 import jax.numpy as jnp
-                self.loss_history.append(float(jnp.mean(jnp.stack(epoch_losses))))
+                # one host sync per epoch; atleast_1d also admits the vector
+                # losses of the kernels.*_scan API
+                flat_losses = jnp.concatenate(
+                    [jnp.atleast_1d(l) for l in epoch_losses])
+                self.loss_history.append(float(jnp.mean(flat_losses)))
         return self
 
     def _fit_chunk(self, chunk, total_expected, epoch_losses):

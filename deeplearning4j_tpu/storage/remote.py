@@ -64,7 +64,10 @@ class RemoteUIStatsStorageRouter:
         timeout) instead of the full retry budget. Returns True when every
         queued record was delivered; False if records were dropped."""
         self._shutdown = True
-        self._q.put(self._END)
+        try:
+            self._q.put_nowait(self._END)  # full queue: worker exits via the
+        except queue.Full:                 # shutdown flag in its get loop
+            pass
         self._thread.join(timeout)
         flushed = self._q.empty() and not self._thread.is_alive()
         if not flushed:
@@ -76,7 +79,12 @@ class RemoteUIStatsStorageRouter:
     def _worker(self):
         import time
         while True:
-            item = self._q.get()
+            try:
+                item = self._q.get(timeout=0.25)
+            except queue.Empty:
+                if self._shutdown:
+                    return  # drained (or the _END marker never fit)
+                continue
             if item is self._END:
                 return
             body = json.dumps(item).encode("utf-8")

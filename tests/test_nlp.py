@@ -210,3 +210,29 @@ def test_cbow_hs_no_crash():
                      batch_size=64)
     model.fit(sents)
     assert model.word_vector("cat") is not None
+
+
+def test_scanned_kernels_match_sequential():
+    """kernels.*_scan fold a whole chunk of batches into one dispatch; the
+    math must be identical to iterating the per-batch steps."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nlp import kernels
+    V, D, B, K, k = 40, 8, 16, 3, 4
+    rng = np.random.default_rng(3)
+    syn0 = rng.standard_normal((V, D)).astype(np.float32)
+    syn1 = rng.standard_normal((V, D)).astype(np.float32)
+    ce = rng.integers(0, V, (k, B)).astype(np.int32)
+    ct = rng.integers(0, V, (k, B)).astype(np.int32)
+    ng = rng.integers(0, V, (k, B, K)).astype(np.int32)
+    wm = np.ones((k, B), np.float32)
+    s0, s1 = jnp.asarray(syn0), jnp.asarray(syn1)
+    seq_losses = []
+    for i in range(k):
+        s0, s1, l = kernels.sgns_step(s0, s1, ce[i], ct[i], ng[i], wm[i],
+                                      jnp.float32(0.05))
+        seq_losses.append(float(l))
+    S0, S1, L = kernels.sgns_scan(jnp.asarray(syn0), jnp.asarray(syn1),
+                                  ce, ct, ng, wm, jnp.float32(0.05))
+    np.testing.assert_allclose(np.asarray(S0), np.asarray(s0), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(S1), np.asarray(s1), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(L), seq_losses, atol=1e-6)
