@@ -1,0 +1,92 @@
+"""Pallas TPU scatter-add kernel for embedding-table updates.
+
+The embedding-update segment-sum has three implementations in this
+framework, chosen by regime (all exact up to dtype):
+
+| path | where | measured (V=10k, N=49k, D=128, v5e) |
+|---|---|---|
+| XLA ``.at[].add`` scatter | any | 253 ms |
+| one-hot bf16 matmul (kernels.py) | TPU, ``N*V*2B`` under gate | 19 ms |
+| this Pallas kernel | TPU, table scratch fits VMEM | 158 ms |
+
+The Pallas kernel streams (idx, grads) blocks through VMEM while the whole
+table rides a persistent VMEM scratch accumulator (the input buffer itself
+is donated to the output), applying rows serially — the dependency chain
+of duplicate indices is respected EXACTLY, not just in expectation like
+the count-normalized scatter. ``kernels._scatter_mean_update`` dispatches
+here automatically in the regime where the one-hot path is memory-gated
+out but the table still fits VMEM; call it directly when exact sequential
+accumulation matters.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# table scratch must fit VMEM alongside the streamed blocks
+VMEM_TABLE_BYTES = 12 * 1024 * 1024
+
+
+def fits_vmem(table) -> bool:
+    return table.size * table.dtype.itemsize <= VMEM_TABLE_BYTES
+
+
+def scatter_add_pallas(table, idx, grads, block: int = 1024):
+    """table[idx[n]] += grads[n] for n in order; exact duplicate handling.
+
+    table (V, D) float32, idx (N,) int32 (any N — ragged tails pad
+    internally with zero-gradient rows), grads (N, D) float32. Off TPU, or
+    when the table exceeds the VMEM budget, falls back to ``.at[].add``."""
+    # the whole table lives in a VMEM scratch accumulator; past the budget
+    # the kernel cannot compile, so large tables take the XLA scatter
+    if jax.default_backend() != "tpu" or not fits_vmem(table):
+        return table.at[idx].add(grads)
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = idx.shape[0]
+    if n % block:
+        pad = block - n % block
+        idx = jnp.pad(idx, (0, pad))
+        # padded rows add zeros to row idx=0: harmless
+        grads = jnp.pad(grads, ((0, pad), (0, 0)))
+        n = idx.shape[0]
+    V, D = table.shape
+
+    def kernel(idx_ref, grads_ref, table_ref, out_ref, acc_ref):
+        # VMEM scratch persists across grid iterations: init from the table
+        # on the first step, accumulate, write out on the last. (Accumulating
+        # directly into a revisited aliased output block races with its
+        # block-fetch pipelining.)
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _():
+            acc_ref[:] = table_ref[:]
+
+        def body(i, _):
+            acc_ref[idx_ref[i], :] += grads_ref[i, :]
+            return 0
+        jax.lax.fori_loop(0, block, body, 0)
+
+        @pl.when(step == pl.num_programs(0) - 1)
+        def _():
+            out_ref[:] = acc_ref[:]
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(table.shape, table.dtype),
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec((block,), lambda i: (i,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((block, D), lambda i: (i, 0)),
+            pl.BlockSpec((V, D), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((V, D), lambda i: (0, 0)),
+        scratch_shapes=[pltpu.VMEM((V, D), table.dtype)],
+        input_output_aliases={2: 0},  # donate the table buffer
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )(idx, grads, table)

@@ -236,3 +236,25 @@ def test_scanned_kernels_match_sequential():
     np.testing.assert_allclose(np.asarray(S0), np.asarray(s0), atol=1e-6)
     np.testing.assert_allclose(np.asarray(S1), np.asarray(s1), atol=1e-6)
     np.testing.assert_allclose(np.asarray(L), seq_losses, atol=1e-6)
+
+
+def test_pallas_scatter_add():
+    """scatter_add_pallas: exact accumulation (falls back to .at[].add off
+    TPU, runs the Pallas kernel on the chip)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nlp.pallas_scatter import scatter_add_pallas
+    rng = np.random.default_rng(7)
+    V, D, N = 50, 8, 96
+    idx = jnp.asarray(rng.integers(0, V, N).astype(np.int32))
+    grads = jnp.asarray(rng.standard_normal((N, D)).astype(np.float32))
+    out = scatter_add_pallas(jnp.zeros((V, D), jnp.float32), idx, grads,
+                             block=32)
+    want = np.zeros((V, D), np.float32)
+    np.add.at(want, np.asarray(idx), np.asarray(grads))
+    np.testing.assert_allclose(np.asarray(out), want, atol=1e-5)
+    # non-multiple-of-block N pads internally
+    out2 = scatter_add_pallas(jnp.zeros((V, D), jnp.float32), idx[:50],
+                              grads[:50], block=32)
+    want2 = np.zeros((V, D), np.float32)
+    np.add.at(want2, np.asarray(idx[:50]), np.asarray(grads[:50]))
+    np.testing.assert_allclose(np.asarray(out2), want2, atol=1e-5)

@@ -192,3 +192,19 @@ def test_ring_attention_jit_compiles(devices):
 
     out = f(q)
     assert out.shape == (1, 1, 64, 8)
+
+
+def test_flash_self_attention_fallback_matches_reference(devices):
+    # CPU backend: routes to reference_attention — same numbers by definition,
+    # but the wrapper's shape/scale contract is what this pins
+    from deeplearning4j_tpu.parallel import flash_self_attention
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.standard_normal((2, 3, 16, 8)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 3, 16, 8)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 3, 16, 8)), jnp.float32)
+    for causal in (False, True):
+        got = flash_self_attention(q, k, v, causal=causal)
+        want = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-2 if jax.default_backend() == "tpu"
+                                   else 1e-6)
