@@ -127,6 +127,30 @@ class Evaluation:
         fp = self._fp(cls)
         return float(fp / (fp + tn)) if (fp + tn) else 0.0
 
+    def merge(self, other: "Evaluation"):
+        """Accumulate another Evaluation (reference Evaluation.merge :1392 —
+        the distributed/Spark aggregation contract: per-host evals merge
+        into one). A fresh accumulator adopts the other's configuration."""
+        if other.confusion is None:  # other never evaluated anything
+            return self
+        if self.confusion is None:
+            self.top_n = other.top_n
+            if self.label_names is None:
+                self.label_names = other.label_names
+            self._ensure(other.n_classes)
+        elif self.n_classes != other.n_classes:
+            raise ValueError(
+                f"Cannot merge {other.n_classes}-class into "
+                f"{self.n_classes}-class Evaluation")
+        if self.top_n != other.top_n:
+            raise ValueError(
+                f"Cannot merge top_n={other.top_n} stats into top_n="
+                f"{self.top_n} (top-N counts would be incoherent)")
+        self.confusion.matrix += other.confusion.matrix
+        self._top_n_correct += other._top_n_correct
+        self._top_n_total += other._top_n_total
+        return self
+
     def stats(self) -> str:
         """Human-readable summary (reference Evaluation.stats :499)."""
         names = self.label_names or [str(i) for i in range(self.n_classes)]
@@ -171,6 +195,30 @@ class EvaluationBinary:
         self.fp += (~lab & preds).sum(0)
         self.tn += (~lab & ~preds).sum(0)
         self.fn += (lab & ~preds).sum(0)
+
+    def merge(self, other: "EvaluationBinary"):
+        """reference EvaluationBinary.merge (distributed aggregation)."""
+        if other.tp is None:
+            return self
+        if self.threshold != other.threshold:
+            raise ValueError(
+                f"Cannot merge threshold={other.threshold} stats into "
+                f"threshold={self.threshold} (counts would be incoherent)")
+        if self.tp is None:
+            self.tp = other.tp.copy()
+            self.fp = other.fp.copy()
+            self.tn = other.tn.copy()
+            self.fn = other.fn.copy()
+            return self
+        if len(self.tp) != len(other.tp):
+            raise ValueError(
+                f"Cannot merge {len(other.tp)}-output stats into "
+                f"{len(self.tp)}-output EvaluationBinary")
+        self.tp += other.tp
+        self.fp += other.fp
+        self.tn += other.tn
+        self.fn += other.fn
+        return self
 
     def accuracy(self, i: int) -> float:
         tot = self.tp[i] + self.fp[i] + self.tn[i] + self.fn[i]

@@ -86,6 +86,21 @@ class RegressionEvaluation:
     def average_mean_squared_error(self) -> float:
         return float(np.mean(self.sum_err2 / self.n))
 
+    def merge(self, other: "RegressionEvaluation"):
+        """reference RegressionEvaluation.merge (distributed aggregation):
+        all accumulators are sums, so merging is elementwise addition."""
+        if other.sum_err2 is None:
+            return self
+        if self.sum_err2 is None:
+            self._alloc(len(other.sum_err2))
+        elif len(self.sum_err2) != len(other.sum_err2):
+            raise ValueError("Column-count mismatch in merge")
+        self.n += other.n
+        for name in ("sum_err2", "sum_abs", "sum_label", "sum_label2",
+                     "sum_pred", "sum_pred2", "sum_lp"):
+            setattr(self, name, getattr(self, name) + getattr(other, name))
+        return self
+
     def stats(self) -> str:
         c = len(self.sum_err2)
         lines = ["Column    MSE            MAE            RMSE           RSE            PC             R^2"]

@@ -128,6 +128,19 @@ class ROC:
         precision = np.concatenate([[1.0], precision])
         return float(np.trapezoid(precision, recall))
 
+    def merge(self, other: "ROC"):
+        """reference ROC.merge (distributed aggregation). Exact mode
+        concatenates retained arrays; thresholded mode adds histograms."""
+        if self.threshold_steps != other.threshold_steps:
+            raise ValueError("Cannot merge ROCs with different threshold_steps")
+        if self.threshold_steps > 0:
+            self._pos_hist += other._pos_hist
+            self._neg_hist += other._neg_hist
+        else:
+            self._scores.extend(other._scores)
+            self._labels.extend(other._labels)
+        return self
+
     def get_roc_curve(self, num_points: int = 101):
         """(fpr, tpr) arrays at score thresholds (reference curves/RocCurve)."""
         if self.threshold_steps > 0:

@@ -237,3 +237,65 @@ def test_lr_schedule_map_policy():
         updates, state = tx.update({"w": jnp.ones(())}, state, params)
         applied.append(round(float(-updates["w"]), 6))
     assert applied == pytest.approx([0.1, 0.1, 0.1, 0.01, 0.01])
+
+
+def test_merge_distributed_aggregation():
+    """merge() — the reference's Spark per-host aggregation contract
+    (Evaluation.java:1392): evaluating halves separately and merging must
+    equal one evaluation of the whole."""
+    from deeplearning4j_tpu.eval import Evaluation, RegressionEvaluation
+    rng = np.random.default_rng(0)
+    y = np.eye(3)[rng.integers(0, 3, 200)]
+    p = rng.random((200, 3))
+    p = p / p.sum(1, keepdims=True)
+    whole = Evaluation()
+    whole.eval(y, p)
+    a, b = Evaluation(), Evaluation()
+    a.eval(y[:120], p[:120])
+    b.eval(y[120:], p[120:])
+    a.merge(b)
+    assert a.accuracy() == pytest.approx(whole.accuracy())
+    assert np.array_equal(a.confusion.matrix, whole.confusion.matrix)
+    # regression
+    t = rng.standard_normal((100, 2))
+    q = t + 0.1 * rng.standard_normal((100, 2))
+    rw = RegressionEvaluation()
+    rw.eval(t, q)
+    ra, rb = RegressionEvaluation(), RegressionEvaluation()
+    ra.eval(t[:50], q[:50])
+    rb.eval(t[50:], q[50:])
+    ra.merge(rb)
+    assert ra.mean_squared_error(0) == pytest.approx(rw.mean_squared_error(0))
+    # ROC: both modes
+    yb, sb = (rng.random(300) < 0.4).astype(float), rng.random(300)
+    for steps in (0, 100):
+        rocw = ROC(steps)
+        rocw.eval(yb, sb)
+        r1, r2 = ROC(steps), ROC(steps)
+        r1.eval(yb[:150], sb[:150])
+        r2.eval(yb[150:], sb[150:])
+        r1.merge(r2)
+        assert r1.calculate_auc() == pytest.approx(rocw.calculate_auc())
+    with pytest.raises(ValueError, match="threshold_steps"):
+        ROC(0).merge(ROC(50))
+    # merge guards: fresh accumulator adopts config; mismatches are loud
+    tn = Evaluation(top_n=3)
+    tn.eval(y, p)
+    fresh = Evaluation().merge(tn)
+    assert fresh.top_n == 3
+    assert fresh.top_n_accuracy() == pytest.approx(tn.top_n_accuracy())
+    with pytest.raises(ValueError, match="top_n"):
+        a2 = Evaluation(top_n=2)
+        a2.eval(y, p)
+        a2.merge(tn)
+    # merging a never-evaluated (but configured) Evaluation is a no-op
+    before = whole.accuracy()
+    whole.merge(Evaluation(n_classes=3))
+    assert whole.accuracy() == before
+    from deeplearning4j_tpu.eval import EvaluationBinary
+    with pytest.raises(ValueError, match="threshold"):
+        e1 = EvaluationBinary(0.5)
+        e1.eval((y > 0.5), p)
+        e2 = EvaluationBinary(0.9)
+        e2.eval((y > 0.5), p)
+        e1.merge(e2)
