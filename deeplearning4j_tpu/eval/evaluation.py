@@ -134,9 +134,8 @@ class Evaluation:
         if other.confusion is None:  # other never evaluated anything
             return self
         if self.confusion is None:
-            self.top_n = other.top_n
-            if self.label_names is None:
-                self.label_names = other.label_names
+            if self.top_n == 1:  # unconfigured default adopts other's
+                self.top_n = other.top_n
             self._ensure(other.n_classes)
         elif self.n_classes != other.n_classes:
             raise ValueError(
@@ -146,6 +145,8 @@ class Evaluation:
             raise ValueError(
                 f"Cannot merge top_n={other.top_n} stats into top_n="
                 f"{self.top_n} (top-N counts would be incoherent)")
+        if self.label_names is None:  # direction-independent stats() output
+            self.label_names = other.label_names
         self.confusion.matrix += other.confusion.matrix
         self._top_n_correct += other._top_n_correct
         self._top_n_total += other._top_n_total

@@ -222,6 +222,20 @@ class ROCMultiClass:
     def calculate_auc(self, cls: int) -> float:
         return self._rocs[cls].calculate_auc()
 
+    def merge(self, other: "ROCMultiClass"):
+        """reference ROCMultiClass.merge: delegate per class."""
+        if other._rocs is None:
+            return self
+        if self._rocs is None:
+            self._rocs = [ROC() for _ in other._rocs]
+        if len(self._rocs) != len(other._rocs):
+            raise ValueError(
+                f"Cannot merge {len(other._rocs)}-class into "
+                f"{len(self._rocs)}-class ROCMultiClass")
+        for mine, theirs in zip(self._rocs, other._rocs):
+            mine.merge(theirs)
+        return self
+
     def calculate_average_auc(self) -> float:
         vals = [r.calculate_auc() for r in self._rocs]
         vals = [v for v in vals if not np.isnan(v)]
@@ -263,6 +277,23 @@ class ROCBinary:
 
     def calculate_auprc(self, output: int) -> float:
         return self._rocs[output].calculate_auprc()
+
+    def merge(self, other: "ROCBinary"):
+        """reference ROCBinary.merge: delegate per output column."""
+        if other._rocs is None:
+            return self
+        if self.threshold_steps != other.threshold_steps:
+            raise ValueError("Cannot merge ROCBinary with different "
+                             "threshold_steps")
+        if self._rocs is None:
+            self._rocs = [ROC(self.threshold_steps) for _ in other._rocs]
+        if len(self._rocs) != len(other._rocs):
+            raise ValueError(
+                f"Cannot merge {len(other._rocs)}-output into "
+                f"{len(self._rocs)}-output ROCBinary")
+        for mine, theirs in zip(self._rocs, other._rocs):
+            mine.merge(theirs)
+        return self
 
     def calculate_average_auc(self) -> float:
         vals = [r.calculate_auc() for r in self._rocs]

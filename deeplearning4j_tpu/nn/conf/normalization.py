@@ -48,17 +48,26 @@ class BatchNormalization(BaseLayer):
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         axes = tuple(range(x.ndim - 1))  # all but channel/feature axis
+        # batch statistics and the running buffers stay float32 regardless of
+        # the compute dtype (bf16 stats lose precision); the normalization
+        # itself runs in x's dtype so bf16 activations stay bf16 end to end
         if train:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            # upcast ONLY low-precision compute dtypes (f64 gradcheck runs
+            # must keep their precision)
+            xf = (x.astype(jnp.float32)
+                  if x.dtype in (jnp.bfloat16, jnp.float16) else x)
+            mean32 = jnp.mean(xf, axis=axes)
+            var32 = jnp.var(xf, axis=axes)
             new_state = {
-                "mean": self.decay * state["mean"] + (1.0 - self.decay) * mean,
-                "var": self.decay * state["var"] + (1.0 - self.decay) * var,
+                "mean": self.decay * state["mean"] + (1.0 - self.decay) * mean32,
+                "var": self.decay * state["var"] + (1.0 - self.decay) * var32,
             }
         else:
-            mean, var = state["mean"], state["var"]
+            mean32, var32 = state["mean"], state["var"]
             new_state = state
-        xhat = (x - mean) * lax.rsqrt(var + self.eps)
+        mean = mean32.astype(x.dtype)
+        var = var32.astype(x.dtype)
+        xhat = (x - mean) * lax.rsqrt(var + jnp.asarray(self.eps, x.dtype))
         if self.lock_gamma_beta:
             out = self.gamma * xhat + self.beta
         else:

@@ -283,3 +283,35 @@ def test_global_pooling_keep_dimensions():
     assert (t.kind, t.height, t.width, t.channels) == ("cnn", 1, 1, 3)
     net = MultiLayerNetwork(conf).init()
     assert net.output(np.ones((2, 4, 4, 3), np.float32)).shape == (2, 2)
+
+
+def _bn_conf(dtype="float32", seed=12345):
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).dtype(dtype)
+         .updater(Adam(learning_rate=1e-3)).weight_init("xavier")
+         .list()
+         .layer(ConvolutionLayer(n_out=6, kernel_size=(3, 3),
+                                 convolution_mode="same", activation="identity"))
+         .layer(BatchNormalization())
+         .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+         .layer(DenseLayer(n_out=16, activation="relu"))
+         .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+         .set_input_type(InputType.convolutional_flat(28, 28, 1)))
+    return b.build()
+
+
+def test_bfloat16_inference_path():
+    """bf16 compute end-to-end through conv+BN: eval-mode batchnorm must
+    normalize in the compute dtype (f32 running stats upcasting activations
+    used to break conv dtype matching at the next layer)."""
+    ds = next(iter(MnistDataSetIterator(batch=16, num_examples=16)))
+    net = MultiLayerNetwork(_bn_conf("bfloat16")).init()
+    net.fit(ds)
+    assert np.isfinite(net.score())
+    out = net.output(ds.features)  # inference-mode BN
+    assert out.shape == (16, 10) and np.isfinite(np.asarray(out)).all()
+    # same-seed f32 net agrees to bf16 tolerance
+    ref = MultiLayerNetwork(_bn_conf("float32")).init()
+    ref.fit(ds)
+    np.testing.assert_allclose(np.asarray(ref.output(ds.features)),
+                               np.asarray(out), atol=0.05)

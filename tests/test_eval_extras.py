@@ -299,3 +299,15 @@ def test_merge_distributed_aggregation():
         e2 = EvaluationBinary(0.9)
         e2.eval((y > 0.5), p)
         e1.merge(e2)
+    # ROCBinary delegates per output
+    rbw = ROCBinary()
+    rbw.eval((y > 0.5), p)
+    rb1, rb2 = ROCBinary(), ROCBinary()
+    rb1.eval((y[:100] > 0.5), p[:100])
+    rb2.eval((y[100:] > 0.5), p[100:])
+    rb1.merge(rb2)
+    for c in range(3):
+        assert rb1.calculate_auc(c) == pytest.approx(rbw.calculate_auc(c))
+    # configured-but-fresh accumulator keeps its explicit top_n
+    with pytest.raises(ValueError, match="top_n"):
+        Evaluation(top_n=5).merge(tn)  # tn has top_n=3
