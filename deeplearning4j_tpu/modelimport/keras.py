@@ -19,7 +19,8 @@ from typing import Dict, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.modelimport.hdf5 import Hdf5Archive
+from deeplearning4j_tpu.modelimport.hdf5 import (Hdf5Archive,
+                                                 open_model_archive)
 from deeplearning4j_tpu.modelimport.keras_layers import (
     KerasImportError, KerasLayerSpec, convert_layer, map_loss,
 )
@@ -113,7 +114,10 @@ def _input_type_from_shape(shape: tuple, first_spec: KerasLayerSpec) -> InputTyp
 
 def _read_layer_weights(archive: Hdf5Archive) -> Dict[str, List[np.ndarray]]:
     """Read per-layer weight lists (reference KerasModel weight copy: the
-    ``model_weights`` group's layer_names/weight_names attributes)."""
+    ``model_weights`` group's layer_names/weight_names attributes; the
+    Keras 3 ``.keras`` archive carries its own layers/<name>/vars layout)."""
+    if hasattr(archive, "layer_weights"):
+        return archive.layer_weights()
     root: Tuple[str, ...] = ()
     if archive.has_group("model_weights"):
         root = ("model_weights",)
@@ -239,10 +243,10 @@ def import_keras_sequential_model_and_weights(
         raise KerasImportError(
             "Either a full-model .h5 path or weights_path must be provided "
             "(got path=None, weights_path=None)")
-    archive = Hdf5Archive(path) if path is not None else None
+    archive = open_model_archive(path) if path is not None else None
     warchive = archive
     if weights_path is not None:
-        warchive = Hdf5Archive(weights_path)
+        warchive = open_model_archive(weights_path)
     try:
         config = _model_config(archive, model_json)
         if config.get("class_name") not in ("Sequential",):
@@ -385,10 +389,10 @@ def import_keras_model_and_weights(
         raise KerasImportError(
             "Either a full-model .h5 path or weights_path must be provided "
             "(got path=None, weights_path=None)")
-    archive = Hdf5Archive(path) if path is not None else None
+    archive = open_model_archive(path) if path is not None else None
     warchive = archive
     if weights_path is not None:
-        warchive = Hdf5Archive(weights_path)
+        warchive = open_model_archive(weights_path)
     try:
         config = _model_config(archive, model_json)
         if config.get("class_name") == "Sequential":
@@ -416,7 +420,7 @@ def import_keras_model_and_weights(
 def import_keras_model(path: str, **kw):
     """Auto-detect sequential vs functional (reference KerasModelImport
     single-file entry points)."""
-    with Hdf5Archive(path) as archive:
+    with open_model_archive(path) as archive:
         config = _model_config(archive, None)
     if config.get("class_name") == "Sequential":
         return import_keras_sequential_model_and_weights(path, **kw)

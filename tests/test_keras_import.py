@@ -274,3 +274,59 @@ class TestExpandedConverterSet:
         assert out[0, 0, 0, 2] == pytest.approx(128.0 - VGG_MEAN_RGB[0])
         with pytest.raises(ValueError):
             TrainedModels.get_pre_processor("resnet")
+
+
+class TestKerasV3Format:
+    """Keras 3 native ``.keras`` zips (config.json + model.weights.h5 with
+    the layers/<name>/vars layout) import through the same entry points."""
+
+    def test_sequential_keras_v3(self, tmp_path):
+        rng = np.random.default_rng(8)
+        m = keras.Sequential([
+            keras.layers.Input((10, 10, 2)),
+            keras.layers.Conv2D(4, (3, 3), activation="relu", padding="same"),
+            keras.layers.MaxPooling2D((2, 2)),
+            keras.layers.Flatten(),
+            keras.layers.Dense(6, activation="relu"),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        path = str(tmp_path / "m.keras")
+        m.compile(loss="categorical_crossentropy", optimizer="sgd")
+        m.save(path)
+        net = import_keras_model(path)
+        x = rng.standard_normal((4, 10, 10, 2)).astype(np.float32)
+        np.testing.assert_allclose(net.output(x), np.asarray(m(x)), atol=1e-5)
+        # loss came through compile_config
+        from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer
+        assert isinstance(net.layers[-1], BaseOutputLayer)
+
+    def test_functional_keras_v3(self, tmp_path):
+        rng = np.random.default_rng(9)
+        inp = keras.Input((6,))
+        a = keras.layers.Dense(5, activation="tanh")(inp)
+        b = keras.layers.Dense(5, activation="relu")(inp)
+        o = keras.layers.Dense(2, activation="softmax")(
+            keras.layers.Concatenate()([a, b]))
+        fm = keras.Model(inp, o)
+        path = str(tmp_path / "f.keras")
+        fm.compile(loss="categorical_crossentropy", optimizer="sgd")
+        fm.save(path)
+        cg = import_keras_model(path)
+        x = rng.standard_normal((4, 6)).astype(np.float32)
+        np.testing.assert_allclose(cg.output_single(x), np.asarray(fm(x)),
+                                   atol=1e-5)
+
+    def test_recurrent_keras_v3(self, tmp_path):
+        rng = np.random.default_rng(10)
+        m = keras.Sequential([
+            keras.layers.Input((7, 4)),
+            keras.layers.GRU(6, return_sequences=True, reset_after=True),
+            keras.layers.LSTM(5),
+            keras.layers.Dense(2),
+        ])
+        path = str(tmp_path / "r.keras")
+        m.compile(loss="mse", optimizer="sgd")
+        m.save(path)
+        net = import_keras_model(path)
+        x = rng.standard_normal((3, 7, 4)).astype(np.float32)
+        np.testing.assert_allclose(net.output(x), np.asarray(m(x)), atol=1e-5)
