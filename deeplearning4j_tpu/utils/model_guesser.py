@@ -1,0 +1,55 @@
+"""Load a model from a file of unknown provenance.
+
+Parity surface: reference
+``deeplearning4j-core/.../util/ModelGuesser.java`` — ``loadModelGuess``
+(native model zip vs Keras file), ``loadConfigGuess`` (MLN vs CG JSON).
+"""
+
+from __future__ import annotations
+
+import json
+import zipfile
+
+
+def load_config_guess(source: str):
+    """Parse a config that may be a MultiLayerConfiguration or a
+    ComputationGraphConfiguration (reference ModelGuesser.loadConfigGuess
+    :51). ``source`` is a JSON string or a path to one."""
+    import os
+
+    from deeplearning4j_tpu.nn.conf.graph import ComputationGraphConfiguration
+    from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+    if os.path.exists(source):
+        with open(source, "r", encoding="utf-8") as f:
+            source = f.read()
+    elif not source.lstrip().startswith("{"):
+        raise ValueError(f"No such configuration file: {source!r}")
+    d = json.loads(source)
+    if "vertices" in d or "network_inputs" in d:
+        return ComputationGraphConfiguration.from_json(source)
+    if "layers" in d:
+        return MultiLayerConfiguration.from_json(source)
+    raise ValueError("Unrecognized configuration JSON: neither a layer list "
+                     "nor a graph (no 'layers'/'vertices' key)")
+
+
+def load_model_guess(path: str):
+    """Load a model whose format is unknown (reference
+    ModelGuesser.loadModelGuess :114): the framework's own zip (metadata.json
+    + configuration.json), a Keras 3 ``.keras`` zip, or a Keras HDF5 file."""
+    if zipfile.is_zipfile(path):
+        with zipfile.ZipFile(path) as z:
+            names = set(z.namelist())
+        if "metadata.json" in names and "configuration.json" in names:
+            from deeplearning4j_tpu.utils.serialization import restore
+            return restore(path)
+    # anything else is a Keras format — import_keras_model's archive opener
+    # already dispatches .keras zips vs HDF5 and validates both
+    from deeplearning4j_tpu.modelimport import (KerasImportError,
+                                                import_keras_model)
+    try:
+        return import_keras_model(path)
+    except (KerasImportError, OSError) as e:
+        raise ValueError(
+            f"Cannot guess the model format of {path!r}: neither a "
+            f"framework model zip nor a Keras file ({e})") from e

@@ -187,3 +187,40 @@ def test_nearest_neighbors_server():
         assert all(r["label"] == "b" for r in res2)
     finally:
         srv.stop()
+
+
+def test_model_guesser(tmp_path):
+    """reference ModelGuesser.loadModelGuess/loadConfigGuess."""
+    from deeplearning4j_tpu.utils.model_guesser import (load_config_guess,
+                                                        load_model_guess)
+    from deeplearning4j_tpu.utils.serialization import write_model
+    net = _net()
+    ds = _toy()
+    net.fit(ds)
+    # framework zip
+    zpath = str(tmp_path / "native.zip")
+    write_model(net, zpath)
+    loaded = load_model_guess(zpath)
+    np.testing.assert_allclose(loaded.output(ds.features),
+                               net.output(ds.features), atol=1e-6)
+    # config guessing: MLN json
+    conf = load_config_guess(net.conf.to_json())
+    from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+    assert isinstance(conf, MultiLayerConfiguration)
+    # keras h5 + .keras (if keras available)
+    keras = pytest.importorskip("keras")
+    m = keras.Sequential([keras.layers.Input((4,)),
+                          keras.layers.Dense(2, activation="softmax")])
+    m.compile(loss="categorical_crossentropy", optimizer="sgd")
+    h5 = str(tmp_path / "k.h5")
+    v3 = str(tmp_path / "k.keras")
+    m.save(h5)
+    m.save(v3)
+    x = np.random.default_rng(0).standard_normal((3, 4)).astype(np.float32)
+    for p in (h5, v3):
+        g = load_model_guess(p)
+        np.testing.assert_allclose(g.output(x), np.asarray(m(x)), atol=1e-5)
+    with pytest.raises(ValueError, match="guess|neither"):
+        bad = str(tmp_path / "junk.bin")
+        open(bad, "wb").write(b"not a model")
+        load_model_guess(bad)
