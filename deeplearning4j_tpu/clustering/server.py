@@ -93,9 +93,11 @@ class NearestNeighborsServer:
         self.tree = VPTree(self.points, distance=distance)
         self._httpd: Optional[ThreadingHTTPServer] = None
 
-    def start(self, port: int = 9200) -> "NearestNeighborsServer":
+    def start(self, port: int = 9200,
+              bind_address: str = "127.0.0.1") -> "NearestNeighborsServer":
+        # loopback by default; pass bind_address="0.0.0.0" to serve remotely
         handler = type("BoundNNHandler", (_Handler,), {"server_ref": self})
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
+        self._httpd = ThreadingHTTPServer((bind_address, port), handler)
         self.port = self._httpd.server_address[1]
         threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
         return self

@@ -29,10 +29,15 @@ _tried = False
 
 
 def build_native(force: bool = False) -> bool:
-    """Compile the native components in-tree (g++). Returns success."""
-    if os.path.exists(_SO) and not force:
-        return True
+    """Compile the native components in-tree (g++). Returns success.
+
+    Rebuilds whenever the C++ source is newer than the shared object, so
+    source edits always take effect (the .so itself is never committed)."""
     src = os.path.join(_DIR, "fastcsv.cpp")
+    if os.path.exists(_SO) and not force:
+        if (not os.path.exists(src)
+                or os.path.getmtime(_SO) >= os.path.getmtime(src)):
+            return True
     try:
         subprocess.run(["g++", "-O3", "-shared", "-fPIC", "-o", _SO, src],
                        check=True, capture_output=True)
@@ -47,7 +52,7 @@ def _load() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_SO) and not build_native():
+    if not build_native():  # builds when missing OR stale vs fastcsv.cpp
         return None
     try:
         lib = ctypes.CDLL(_SO)

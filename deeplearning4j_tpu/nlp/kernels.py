@@ -55,7 +55,10 @@ def _scatter_mean_update(table, idx, grads, weights, lr):
     if jax.default_backend() == "tpu":
         if n * V * 2 <= _ONEHOT_BYTES_LIMIT:
             oh = jax.nn.one_hot(idx, V, dtype=jnp.bfloat16)
-            upd = jnp.matmul(oh.T, (grads * scale).astype(jnp.bfloat16))
+            # f32 accumulator output: free on the MXU, avoids rounding the
+            # (V, D) update to bf16 before it lands in the f32 table
+            upd = jnp.matmul(oh.T, (grads * scale).astype(jnp.bfloat16),
+                             preferred_element_type=jnp.float32)
             return table + lr * upd.astype(table.dtype)
         from deeplearning4j_tpu.nlp import pallas_scatter
         if pallas_scatter.fits_vmem(table):

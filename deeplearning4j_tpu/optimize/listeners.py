@@ -251,6 +251,10 @@ class CheckpointListener(TrainingListener):
         from deeplearning4j_tpu.utils.serialization import write_model
         path = os.path.join(self.checkpoint_dir, f"checkpoint_{tag}.zip")
         write_model(model, path, save_updater=self.save_updater)
+        # re-saving an adopted/duplicate tag must not leave a stale entry the
+        # retention loop could later use to delete the fresh file
+        if path in self.saved_paths:
+            self.saved_paths.remove(path)
         self.saved_paths.append(path)
         while len(self.saved_paths) > self.keep_last:
             old = self.saved_paths.pop(0)

@@ -15,8 +15,11 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description="deeplearning4j-tpu training UI")
     ap.add_argument("--file", required=True, help="JSON-lines stats file")
     ap.add_argument("--port", type=int, default=9000)
+    ap.add_argument("--bind-address", default="127.0.0.1",
+                    help="interface to bind (0.0.0.0 exposes remotely)")
     args = ap.parse_args(argv)
-    server = UIServer.get_instance(args.port).attach(FileStatsStorage(args.file))
+    server = UIServer.get_instance(args.port, args.bind_address).attach(
+        FileStatsStorage(args.file))
     print(f"UI server at {server.address} (ctrl-c to stop)", flush=True)
     try:
         while True:

@@ -14,12 +14,15 @@ plus JSON endpoints reading straight from the JSON-record storage.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 from deeplearning4j_tpu.ui.stats import TYPE_ID
+
+log = logging.getLogger(__name__)
 
 _DASHBOARD_HTML = r"""<!DOCTYPE html>
 <html><head><meta charset="utf-8"><title>deeplearning4j-tpu training UI</title>
@@ -296,23 +299,37 @@ class UIServer:
 
     _instance: Optional["UIServer"] = None
 
-    def __init__(self, port: int = 9000):
+    def __init__(self, port: int = 9000, bind_address: str = "127.0.0.1"):
+        # loopback by default: /remoteReceive accepts unauthenticated writes,
+        # so exposing beyond the host is a deliberate opt-in
         self.port = port
+        self.bind_address = bind_address
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.storage = None
 
     @classmethod
-    def get_instance(cls, port: int = 9000) -> "UIServer":
+    def get_instance(cls, port: int = 9000,
+                     bind_address: str = "127.0.0.1") -> "UIServer":
         if cls._instance is None:
-            cls._instance = cls(port)
+            cls._instance = cls(port, bind_address)
+        elif (bind_address != cls._instance.bind_address
+              or port != cls._instance.port):
+            # the singleton keeps first-caller settings; an explicit later
+            # request for a different bind must not be silently dropped
+            log.warning(
+                "UIServer singleton already bound to %s:%s; ignoring request "
+                "for %s:%s (stop() it first to rebind)",
+                cls._instance.bind_address, cls._instance.port,
+                bind_address, port)
         return cls._instance
 
     def attach(self, storage):
         self.storage = storage
         handler = type("BoundHandler", (_Handler,), {"storage": storage})
         if self._httpd is None:
-            self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
+            self._httpd = ThreadingHTTPServer((self.bind_address, self.port),
+                                              handler)
             self.port = self._httpd.server_address[1]
             self._thread = threading.Thread(
                 target=self._httpd.serve_forever, daemon=True)
