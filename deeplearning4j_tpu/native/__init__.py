@@ -52,7 +52,10 @@ def _load() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not build_native():  # builds when missing OR stale vs fastcsv.cpp
+    # builds when missing OR stale vs fastcsv.cpp; if the rebuild fails but
+    # a (possibly stale) .so is already on disk, still load it — a working
+    # fast path beats a silent fallback on toolchain-less machines
+    if not build_native() and not os.path.exists(_SO):
         return None
     try:
         lib = ctypes.CDLL(_SO)
@@ -65,7 +68,9 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_char, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64]
         _lib = lib
-    except OSError as e:
+    except (OSError, AttributeError) as e:
+        # AttributeError: a stale .so loaded via the fallback above may
+        # predate a symbol this binding expects — degrade to Python
         log.info("Native library load failed (%s); using Python fallbacks", e)
     return _lib
 

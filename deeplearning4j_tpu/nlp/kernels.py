@@ -48,18 +48,25 @@ def _scatter_mean_update(table, idx, grads, weights, lr):
     one-hot-matmul MXU path when the transient one-hot fits the budget."""
     V = table.shape[0]
     n = idx.shape[0]
-    cnt = jnp.zeros((V,), table.dtype).at[idx].add(weights)
-    scale = (weights / jnp.maximum(cnt, 1.0)[idx])[:, None]
     # the matmul rewrite only pays where scatters are slow (TPU); CPU keeps
     # the exact fp32 scatter (cheap there, and no bf16 rounding)
     if jax.default_backend() == "tpu":
         if n * V * 2 <= _ONEHOT_BYTES_LIMIT:
             oh = jax.nn.one_hot(idx, V, dtype=jnp.bfloat16)
-            # f32 accumulator output: free on the MXU, avoids rounding the
-            # (V, D) update to bf16 before it lands in the f32 table
-            upd = jnp.matmul(oh.T, (grads * scale).astype(jnp.bfloat16),
-                             preferred_element_type=jnp.float32)
+            # counts ride the SAME matmul as a trailing all-ones column
+            # (a scalar .at[].add count scatter serializes row-by-row on
+            # TPU and dominated this step's profile); f32 accumulator
+            # output is free on the MXU and avoids rounding the (V, D)
+            # update to bf16 before it lands in the f32 table
+            rhs = jnp.concatenate(
+                [(grads * weights[:, None]).astype(jnp.bfloat16),
+                 weights[:, None].astype(jnp.bfloat16)], axis=1)
+            acc = jnp.matmul(oh.T, rhs, preferred_element_type=jnp.float32)
+            upd = acc[:, :-1] / jnp.maximum(acc[:, -1:], 1.0)
             return table + lr * upd.astype(table.dtype)
+    cnt = jnp.zeros((V,), table.dtype).at[idx].add(weights)
+    scale = (weights / jnp.maximum(cnt, 1.0)[idx])[:, None]
+    if jax.default_backend() == "tpu":
         from deeplearning4j_tpu.nlp import pallas_scatter
         if pallas_scatter.fits_vmem(table):
             # above the one-hot gate but table fits VMEM: the Pallas kernel
@@ -306,3 +313,45 @@ sgns_scan = _scanned(sgns_step.__wrapped__)
 hs_scan = _scanned(hs_step.__wrapped__)
 cbow_scan = _scanned(cbow_step.__wrapped__)
 cbow_hs_scan = _scanned(cbow_hs_step.__wrapped__)
+
+
+# ---------------------------------------------------------------------------
+# Macro-dispatch SGNS: one XLA program trains a whole (NB, B) stack of pair
+# batches with negatives drawn ON DEVICE from the unigram table. Motivation
+# (measured on the v5e tunnel): host->device bandwidth is ~16-38 MB/s and
+# per-dispatch overhead ~2.5 ms, so shipping (B, K) negatives per batch and
+# dispatching per batch made the r3 word2vec bench transfer-bound. Here the
+# host ships only the packed pair indices (int16 when the vocab allows) and
+# the device does the rest: ~7x less H2D traffic and NB fewer dispatches.
+
+_sgns_macro_cache = {}
+
+
+def sgns_macro_step(K: int):
+    """Returns the jitted macro step for K negatives (cached per K)."""
+    fn = _sgns_macro_cache.get(K)
+    if fn is not None:
+        return fn
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def run(syn0, syn1neg, neg_table, centers, contexts, key, lr):
+        centers = centers.astype(jnp.int32)
+        contexts = contexts.astype(jnp.int32)
+        B = centers.shape[1]
+        wm = jnp.ones((B,), syn0.dtype)
+        T = neg_table.shape[0]
+
+        def body(carry, inp):
+            s0, s1, k = carry
+            ce, ct = inp
+            k, k2 = jax.random.split(k)
+            negs = neg_table[jax.random.randint(k2, (B, K), 0, T)]
+            s0, s1, loss = sgns_step.__wrapped__(s0, s1, ce, ct, negs, wm, lr)
+            return (s0, s1, k), loss
+
+        (syn0, syn1neg, _), losses = jax.lax.scan(
+            body, (syn0, syn1neg, key), (centers, contexts))
+        return syn0, syn1neg, losses
+
+    _sgns_macro_cache[K] = run
+    return run

@@ -62,6 +62,8 @@ class SequenceVectors:
         self.syn1: Optional[np.ndarray] = None
         self._codes = self._points = self._lengths = None
         self._neg_table: Optional[np.ndarray] = None
+        self._neg_table_dev = None   # device copy, shipped once per fit
+        self._jax_key = None
         self._rng = np.random.default_rng(seed)
         self.words_processed = 0
         self.loss_history: List[float] = []
@@ -80,6 +82,7 @@ class SequenceVectors:
             self._codes, self._points, self._lengths = build_huffman(self.vocab)
         if self.negative > 0:
             self._neg_table = unigram_table(self.vocab)
+            self._neg_table_dev = None
 
     # --------------------------------------------------------- vectorization
     def _index_sequences(self, sequences: Iterable[List[str]]):
@@ -166,6 +169,45 @@ class SequenceVectors:
         widths = ((0, pad),) + ((0, 0),) * (arr.ndim - 1)
         return np.pad(arr, widths, constant_values=fill), wmask
 
+    # full macros of NB x batch_size pairs go through ONE scanned dispatch
+    # with device-side negative sampling (kernels.sgns_macro_step); the
+    # ragged tail falls through to the per-batch path below. NB=8 keeps the
+    # compile cache to one program while amortizing the tunnel's ~2.5 ms
+    # per-dispatch overhead.
+    _MACRO_NB = 8
+
+    def _train_pairs_macro(self, centers, contexts, lr):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nlp import kernels as _k
+        b = self.batch_size
+        macro = b * self._MACRO_NB
+        n_macros = len(centers) // macro
+        if self._neg_table_dev is None:
+            self._neg_table_dev = jnp.asarray(self._neg_table)
+        if self._jax_key is None:
+            self._jax_key = jax.random.key(self.seed)
+        # int16 halves H2D traffic through the tunnel when the tables allow.
+        # Gate on the actual table height, NOT vocab.num_words():
+        # ParagraphVectors appends doc rows beyond the word vocab, and an
+        # int16 cast would silently wrap those indices negative.
+        dt = np.int16 if self.syn0.shape[0] < 2 ** 15 else np.int32
+        step = _k.sgns_macro_step(self.negative)
+        losses = []
+        for m in range(n_macros):
+            sl = slice(m * macro, (m + 1) * macro)
+            ce = np.ascontiguousarray(
+                centers[sl].astype(dt).reshape(self._MACRO_NB, b))
+            ct = np.ascontiguousarray(
+                contexts[sl].astype(dt).reshape(self._MACRO_NB, b))
+            self._jax_key, k = jax.random.split(self._jax_key)
+            self.syn0, self.syn1, l = step(
+                self.syn0, self.syn1, self._neg_table_dev, ce, ct, k,
+                np.float32(lr))
+            losses.append(l)
+        return n_macros * macro, losses
+
     def _train_pairs(self, centers, contexts, lr):
         """Feed (center, context) pairs through the jitted steps in
         batch_size slices; the final ragged slice pads with a zero mask.
@@ -175,7 +217,11 @@ class SequenceVectors:
         per epoch."""
         b = self.batch_size
         losses = []
-        for s in range(0, len(centers), b):
+        start = 0
+        if self.negative > 0 and len(centers) >= b * self._MACRO_NB:
+            start, macro_losses = self._train_pairs_macro(centers, contexts, lr)
+            losses.extend(macro_losses)
+        for s in range(start, len(centers), b):
             ce, ct = centers[s:s + b], contexts[s:s + b]
             ce, wmask = self._pad(ce, b)
             ct, _ = self._pad(ct, b)

@@ -107,15 +107,51 @@ class ConvolutionLayer(BaseLayer):
             params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
         return params, {}
 
+    def _space_to_depth_eligible(self, x):
+        """The ImageNet-stem case (7x7 stride-2 SAME on <=4 channels) maps
+        poorly onto the MXU: <8 input channels waste the systolic array's
+        input tiling. Rewriting via 2x2 space-to-depth turns it into an
+        exact-math 4x4 stride-1 conv over 4x the channels."""
+        return (self.convolution_mode == "same"
+                and _pair(self.kernel_size) == (7, 7)
+                and _pair(self.stride) == (2, 2)
+                and _pair(self.dilation) == (1, 1)
+                and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0
+                and x.shape[3] <= 4)
+
+    @staticmethod
+    def _space_to_depth_conv(x, w):
+        """Exact rewrite of conv(x, w[7,7,C,F], stride 2, SAME) for even H/W.
+
+        SAME here pads (2,3); in 2x2-block space that is pad (1,2) with the
+        7x7 kernel zero-extended to 8x8 (index 7 multiplies only padding).
+        Derivation: output o(i) reads input t = 2i-2..2i+4; with t = 2j+p
+        (j the block index, p the parity) the kernel tap is k = 2(j-i)+p+2,
+        so blocks j-i in -1..2 and W'[a, p] = w[2a+p] (a = j-i+1, w[7] = 0).
+        """
+        b, h, wd, c = x.shape
+        f = w.shape[-1]
+        x2 = x.reshape(b, h // 2, 2, wd // 2, 2, c)
+        x2 = x2.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, wd // 2, 4 * c)
+        w8 = jnp.pad(w, ((0, 1), (0, 1), (0, 0), (0, 0)))
+        w2 = w8.reshape(4, 2, 4, 2, c, f)
+        w2 = w2.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * c, f)
+        return lax.conv_general_dilated(
+            x2, w2, window_strides=(1, 1), padding=((1, 2), (1, 2)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         x = dropout_input(x, self.dropout, train, rng)
-        z = lax.conv_general_dilated(
-            x, params["W"],
-            window_strides=_pair(self.stride),
-            padding=_padding_cfg(self.convolution_mode, self.padding),
-            rhs_dilation=_pair(self.dilation),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        )
+        if self._space_to_depth_eligible(x):
+            z = self._space_to_depth_conv(x, params["W"])
+        else:
+            z = lax.conv_general_dilated(
+                x, params["W"],
+                window_strides=_pair(self.stride),
+                padding=_padding_cfg(self.convolution_mode, self.padding),
+                rhs_dilation=_pair(self.dilation),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
         if self.has_bias:
             z = z + params["b"]
         return get_activation(self.activation)(z), state

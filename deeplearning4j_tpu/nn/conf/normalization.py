@@ -52,12 +52,28 @@ class BatchNormalization(BaseLayer):
         # the compute dtype (bf16 stats lose precision); the normalization
         # itself runs in x's dtype so bf16 activations stay bf16 end to end
         if train:
-            # upcast ONLY low-precision compute dtypes (f64 gradcheck runs
-            # must keep their precision)
-            xf = (x.astype(jnp.float32)
-                  if x.dtype in (jnp.bfloat16, jnp.float16) else x)
-            mean32 = jnp.mean(xf, axis=axes)
-            var32 = jnp.var(xf, axis=axes)
+            if x.dtype in (jnp.bfloat16, jnp.float16):
+                # low-precision compute: single-pass f32-accumulated stats.
+                # sum and sum-of-squares fuse into ONE traversal of x
+                # (jnp.var's mean((x-mean)^2) needs a second, dependent
+                # pass — 2x the HBM reads on conv-sized activations,
+                # measured ~8% of the ResNet50 train step). E[x^2]-E[x]^2
+                # cancellation only bites when mean^2/var >~ 2^24; but bf16
+                # DATA already loses the signal at mean^2/var ~ 2^16, so in
+                # every regime where the input itself is meaningful the
+                # single-pass f32 accumulator is as accurate as two-pass.
+                xf = x.astype(jnp.float32)
+                n = xf.size // xf.shape[-1]
+                mean32 = jnp.sum(xf, axis=axes) / n
+                var32 = jnp.maximum(
+                    jnp.sum(xf * xf, axis=axes) / n - mean32 * mean32, 0.0)
+            else:
+                # full-precision compute (incl. f64 gradcheck): the exact
+                # centered two-pass form — immune to cancellation for
+                # channels whose mean dwarfs their std (e.g. BN applied
+                # directly to unnormalized raw features)
+                mean32 = jnp.mean(x, axis=axes)
+                var32 = jnp.var(x, axis=axes)
             new_state = {
                 "mean": self.decay * state["mean"] + (1.0 - self.decay) * mean32,
                 "var": self.decay * state["var"] + (1.0 - self.decay) * var32,
@@ -65,13 +81,18 @@ class BatchNormalization(BaseLayer):
         else:
             mean32, var32 = state["mean"], state["var"]
             new_state = state
-        mean = mean32.astype(x.dtype)
-        var = var32.astype(x.dtype)
-        xhat = (x - mean) * lax.rsqrt(var + jnp.asarray(self.eps, x.dtype))
+        # fold to one fused multiply-add per element: out = x*scale + shift.
+        # scale/shift are per-channel (C,) vectors computed in f32, so the
+        # per-element work is minimal and fuses into the producing conv.
+        sdt = var32.dtype  # f32 for low-precision compute, f64 for gradcheck
+        inv = lax.rsqrt(var32 + jnp.asarray(self.eps, sdt))
         if self.lock_gamma_beta:
-            out = self.gamma * xhat + self.beta
+            g, b = jnp.asarray(self.gamma, sdt), jnp.asarray(self.beta, sdt)
         else:
-            out = params["gamma"] * xhat + params["beta"]
+            g, b = params["gamma"].astype(sdt), params["beta"].astype(sdt)
+        scale = g * inv
+        shift = b - mean32 * scale
+        out = x * scale.astype(x.dtype) + shift.astype(x.dtype)
         return out, new_state
 
 

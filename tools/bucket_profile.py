@@ -1,0 +1,79 @@
+"""Bucket per-step HLO self-times from the captured xplane.
+PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python python tools/bucket_profile.py [xplane.pb]
+"""
+import collections
+import glob
+import os
+import re
+import sys
+
+from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+STEPS = 10
+
+
+def bucket(name):
+    head = name.split(" = ")[0]
+    # what the op DOES is the first token after '=': e.g. 'fusion(', 'copy('
+    m = re.search(r"= \S+ ([a-z\-_.]+)\(", name)
+    kind = m.group(1) if m else "?"
+    if "convolution" in head or kind == "convolution":
+        return "conv-raw"
+    if "multiply_reduce_fusion" in head:
+        return "bn-reduce"
+    if "select-and-scatter" in head or kind == "select-and-scatter":
+        return "maxpool-bwd"
+    if "copy" in head or kind.startswith("copy"):
+        return "copy"
+    if "add_add_fusion" in head:
+        return "residual-add"
+    if "reduce" in head:
+        return "other-reduce"
+    if "fusion" in head:
+        # classify fusions by their output dtype/shape scale
+        m2 = re.match(r"%\S+ = \(?((?:bf16|f32|s32|pred|u32)\[[^\]]*\])", name)
+        out = m2.group(1) if m2 else "?"
+        if out.startswith("f32[") and ",“" not in out:
+            return f"fusion-f32-small" if "]" in out and out.count(",") <= 1 \
+                else "fusion-f32-big"
+        return "fusion-" + (out[:4] if out != "?" else "?")
+    return kind
+
+
+def dims(out):
+    inner = out.split("[", 1)[1].rstrip("]")
+    return [int(d) for d in inner.split(",") if d.strip().isdigit()]
+
+
+def main():
+    if len(sys.argv) > 1:
+        xp = sys.argv[1]
+    else:
+        xp = sorted(glob.glob(os.path.join(
+            os.path.dirname(__file__), "profile_out",
+            "**", "*.xplane.pb"), recursive=True))[-1]
+    space = xplane_pb2.XSpace()
+    with open(xp, "rb") as f:
+        space.ParseFromString(f.read())
+    for plane in space.planes:
+        if "TPU" not in plane.name:
+            continue
+        emeta = plane.event_metadata
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            agg = collections.defaultdict(float)
+            cnt = collections.Counter()
+            for ev in line.events:
+                name = emeta[ev.metadata_id].name
+                b = bucket(name)
+                agg[b] += ev.duration_ps / 1e12
+                cnt[b] += 1
+            total = sum(agg.values())
+            print(f"total on-device: {total/STEPS*1e3:.2f} ms/step")
+            for b, t in sorted(agg.items(), key=lambda kv: -kv[1]):
+                print(f"  {t/STEPS*1e3:7.2f} ms/step  x{cnt[b]//STEPS:<5d} {b}")
+
+
+if __name__ == "__main__":
+    main()

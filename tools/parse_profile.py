@@ -1,0 +1,48 @@
+"""Parse the captured xplane and print top self-time TPU ops, aggregated by
+HLO op name. PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python python tools/parse_profile.py [xplane.pb]
+"""
+import collections
+import glob
+import os
+import sys
+
+from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+
+def main():
+    if len(sys.argv) > 1:
+        xp = sys.argv[1]
+    else:
+        xp = sorted(glob.glob(os.path.join(
+            os.path.dirname(__file__), "profile_out",
+            "**", "*.xplane.pb"), recursive=True))[-1]
+    space = xplane_pb2.XSpace()
+    with open(xp, "rb") as f:
+        space.ParseFromString(f.read())
+    print("planes:", [(p.name, len(p.lines)) for p in space.planes])
+    for plane in space.planes:
+        if "TPU" not in plane.name and "tpu" not in plane.name.lower():
+            continue
+        emeta = plane.event_metadata
+        for line in plane.lines:
+            # XLA op lines carry per-HLO timing
+            agg = collections.defaultdict(float)
+            cnt = collections.Counter()
+            total = 0.0
+            for ev in line.events:
+                name = emeta[ev.metadata_id].name
+                dur = ev.duration_ps / 1e12
+                agg[name] += dur
+                cnt[name] += 1
+                total += dur
+            if total == 0:
+                continue
+            rows = sorted(agg.items(), key=lambda kv: -kv[1])
+            print(f"\n== plane '{plane.name}' line '{line.name}' "
+                  f"total {total*1e3:.1f} ms over {sum(cnt.values())} events")
+            for name, t in rows[:25]:
+                print(f"  {t*1e3:8.2f} ms  x{cnt[name]:<4d} {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Time ONLY ResNet50's convolutions (fwd + dW + dX) to find the conv ceiling.
+
+Pulls every ConvolutionLayer out of the real graph config with its true input
+shape, then times one jitted program that runs them all and their gradients.
+PYTHONPATH=. python tools/perf_conv_ceiling.py
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.models import ResNet50
+from deeplearning4j_tpu.nn.conf.convolutional import ConvolutionLayer
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+BATCH = 128
+PEAK = 197e12
+
+
+def main():
+    conf = ResNet50(num_classes=1000, input_shape=(224, 224, 3)).conf()
+    net = ComputationGraph(conf)
+    convs = []
+    total_flops = 0
+    for name in net.order:
+        obj, _ = net.vertices[name]
+        if isinstance(obj, ConvolutionLayer):
+            it = net.vertex_input_types[name][0]
+            out_t = obj.output_type(it)
+            kh, kw = obj.kernel_size
+            flops = 2 * BATCH * out_t.height * out_t.width * obj.n_out * \
+                kh * kw * (obj.n_in or it.channels)
+            total_flops += flops
+            convs.append((name, (BATCH, it.height, it.width, it.channels),
+                          (kh, kw, it.channels, obj.n_out), obj.stride,
+                          obj.convolution_mode, obj.padding))
+    print(f"{len(convs)} convs, fwd GFLOP/img: {total_flops/BATCH/1e9:.2f}")
+
+    rng = np.random.default_rng(0)
+    xs = [jnp.asarray(rng.standard_normal(s, np.float32), jnp.bfloat16)
+          for _, s, _, _, _, _ in convs]
+    ws = [jnp.asarray(rng.standard_normal(k, np.float32) * 0.05, jnp.bfloat16)
+          for _, _, k, _, _, _ in convs]
+
+    def loss(ws_, xs_):
+        tot = 0.0
+        for (name, _, _, stride, mode, pad), x, w in zip(convs, xs_, ws_):
+            if mode == "same":
+                padding = "SAME"
+            else:
+                padding = ((pad[0], pad[0]), (pad[1], pad[1]))
+            z = lax.conv_general_dilated(
+                x, w, window_strides=stride, padding=padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            tot = tot + jnp.mean(z.astype(jnp.float32))
+        return tot
+
+    gfn = jax.jit(jax.value_and_grad(loss, argnums=(0, 1)))
+    out = [None]
+
+    def run_one():
+        out[0] = gfn(ws, xs)
+    for _ in range(3):
+        run_one()
+    float(out[0][0])
+    t0 = time.perf_counter()
+    for _ in range(20):
+        run_one()
+    float(out[0][0])
+    dt = (time.perf_counter() - t0) / 20
+    print(f"conv fwd+dW+dX: {dt*1e3:.1f} ms | {3*total_flops/dt/1e12:.1f} TF/s "
+          f"| mfu {3*total_flops/dt/PEAK:.3f}")
+
+    # forward only
+    ffn = jax.jit(loss)
+
+    def run_f():
+        out[0] = ffn(ws, xs)
+    for _ in range(3):
+        run_f()
+    float(out[0])
+    t0 = time.perf_counter()
+    for _ in range(20):
+        run_f()
+    float(out[0])
+    dt = (time.perf_counter() - t0) / 20
+    print(f"conv fwd only : {dt*1e3:.1f} ms | {total_flops/dt/1e12:.1f} TF/s "
+          f"| mfu(fwd) {total_flops/dt/PEAK:.3f}")
+
+
+if __name__ == "__main__":
+    main()

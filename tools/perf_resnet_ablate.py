@@ -1,0 +1,102 @@
+"""Ablate ResNet50 train-step costs: BN variants, precision, stem conv.
+
+Monkeypatches pieces of the stack one at a time and re-times the full train
+step (honest value-fetch sync). PYTHONPATH=. python tools/perf_resnet_ablate.py
+"""
+import dataclasses as dc
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.models import ResNet50
+from deeplearning4j_tpu.nn.conf.normalization import BatchNormalization
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+SIDE = 224
+BATCH = 128
+PEAK = 197e12
+
+
+def _fwd_flops(net):
+    import bench
+    return bench._model_fwd_flops_per_image(net)
+ORIG_APPLY = BatchNormalization.apply
+
+
+def bn_apply_bf16(self, params, state, x, *, train=False, rng=None, mask=None):
+    """BN with stats in compute dtype (no f32 upcast)."""
+    axes = tuple(range(x.ndim - 1))
+    if train:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        new_state = {
+            "mean": self.decay * state["mean"] + (1.0 - self.decay) * mean.astype(jnp.float32),
+            "var": self.decay * state["var"] + (1.0 - self.decay) * var.astype(jnp.float32),
+        }
+    else:
+        mean, var = state["mean"].astype(x.dtype), state["var"].astype(x.dtype)
+        new_state = state
+    mean = mean.astype(x.dtype)
+    var = var.astype(x.dtype)
+    xhat = (x - mean) * lax.rsqrt(var + jnp.asarray(self.eps, x.dtype))
+    out = params["gamma"] * xhat + params["beta"]
+    return out, new_state
+
+
+def bn_apply_frozen(self, params, state, x, *, train=False, rng=None, mask=None):
+    """BN as a pure scale+shift (no batch stats at all) — cost upper bound."""
+    scale = params["gamma"] * lax.rsqrt(state["var"] + self.eps)
+    shift = params["beta"] - state["mean"] * scale
+    return x * scale.astype(x.dtype) + shift.astype(x.dtype), state
+
+
+def time_step(tag):
+    conf = dc.replace(
+        ResNet50(num_classes=1000, input_shape=(SIDE, SIDE, 3)).conf(),
+        dtype="bfloat16")
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((BATCH, SIDE, SIDE, 3), np.float32))
+    y = jnp.asarray(np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, BATCH)])
+    step = net._get_jitted("train")
+    loss = [None]
+
+    def run_one():
+        net._rng, k = jax.random.split(net._rng)
+        net.params, net.state, net.opt_state, loss[0] = step(
+            net.params, net.state, net.opt_state, k, [x], [y], None, None)
+    for _ in range(3):
+        run_one()
+    float(loss[0])
+    t0 = time.perf_counter()
+    for _ in range(20):
+        run_one()
+    float(loss[0])
+    dt = (time.perf_counter() - t0) / 20
+    print(f"{tag:24s}: step {dt*1e3:7.1f} ms | imgs/s {BATCH/dt:8.1f} "
+          f"| mfu {BATCH*3*_fwd_flops(net)/dt/PEAK:.3f}", flush=True)
+
+
+def main():
+    from deeplearning4j_tpu.nn.conf.convolutional import SubsamplingLayer
+    orig_pool = SubsamplingLayer.apply
+
+    def pool_as_avg(self, params, state, x, *, train=False, rng=None, mask=None):
+        self = dc.replace(self, pooling_type="avg")
+        return orig_pool(self, params, state, x, train=train, rng=rng, mask=mask)
+
+    time_step("baseline(f32-stats)")
+    BatchNormalization.apply = bn_apply_frozen
+    time_step("bn-frozen")
+    SubsamplingLayer.apply = pool_as_avg
+    time_step("bn-frozen+avgpool")
+    BatchNormalization.apply = ORIG_APPLY
+    time_step("avgpool-only")
+    SubsamplingLayer.apply = orig_pool
+
+
+if __name__ == "__main__":
+    main()

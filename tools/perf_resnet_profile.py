@@ -1,0 +1,47 @@
+"""Capture a jax.profiler trace of the ResNet50 train step and print the
+top self-time ops from the xplane. PYTHONPATH=. python tools/perf_resnet_profile.py
+"""
+import dataclasses as dc
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models import ResNet50
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+OUT = os.path.join(os.path.dirname(__file__), "profile_out")
+
+
+def main():
+    batch = 128
+    conf = dc.replace(
+        ResNet50(num_classes=1000, input_shape=(224, 224, 3)).conf(),
+        dtype="bfloat16")
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, 224, 224, 3), np.float32))
+    y = jnp.asarray(np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)])
+    step = net._get_jitted("train")
+    loss = [None]
+
+    def run_one():
+        net._rng, k = jax.random.split(net._rng)
+        net.params, net.state, net.opt_state, loss[0] = step(
+            net.params, net.state, net.opt_state, k, [x], [y], None, None)
+    for _ in range(5):
+        run_one()
+    float(loss[0])
+    with jax.profiler.trace(OUT):
+        for _ in range(10):
+            run_one()
+        float(loss[0])
+    print("trace captured to", OUT)
+    for f in glob.glob(OUT + "/**/*.xplane.pb", recursive=True):
+        print("xplane:", f, os.path.getsize(f))
+
+
+if __name__ == "__main__":
+    main()

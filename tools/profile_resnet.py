@@ -28,7 +28,9 @@ from deeplearning4j_tpu.nn.conf.layers import apply_constraints
 from deeplearning4j_tpu.nn.graph import ComputationGraph
 
 SIDE = 224
-TRAIN_FLOPS_PER_IMG = 3 * 4.1e9  # bench.py denominator
+def _train_flops_per_img(net):
+    import bench
+    return 3 * bench._model_fwd_flops_per_image(net)  # graph-derived (r4)
 PEAK = 197e12  # v5e bf16
 
 
@@ -64,7 +66,7 @@ def bench_per_batch(out, batch, dtype="bfloat16", steps=30, warmup=3,
             emit(out, exp="cost_analysis", batch=batch, dtype=dtype,
                  xla_flops=c.get("flops"),
                  xla_flops_per_img=c.get("flops", 0) / batch,
-                 bench_assumed_per_img=TRAIN_FLOPS_PER_IMG)
+                 bench_assumed_per_img=_train_flops_per_img(net))
         except Exception as e:
             emit(out, exp="cost_analysis", error=repr(e))
     loss = None
@@ -90,7 +92,7 @@ def bench_per_batch(out, batch, dtype="bfloat16", steps=30, warmup=3,
     ips = steps * batch / dt
     emit(out, exp="per_batch", batch=batch, dtype=dtype, steps=steps,
          imgs_per_sec=round(ips, 1), ms_per_step=round(1000 * dt / steps, 2),
-         mfu=round(ips * TRAIN_FLOPS_PER_IMG / PEAK, 4),
+         mfu=round(ips * _train_flops_per_img(net) / PEAK, 4),
          compile_s=round(compile_s, 1))
     return ips
 
@@ -136,7 +138,7 @@ def bench_scan(out, batch, K=8, outer=5, dtype="bfloat16"):
     emit(out, exp="scan_fused", batch=batch, K=K, dtype=dtype,
          imgs_per_sec=round(ips, 1),
          ms_per_step=round(1000 * dt / (outer * K), 2),
-         mfu=round(ips * TRAIN_FLOPS_PER_IMG / PEAK, 4))
+         mfu=round(ips * _train_flops_per_img(net) / PEAK, 4))
     return ips
 
 
