@@ -304,6 +304,20 @@ class ComputationGraphConfiguration:
     tbptt_fwd_length: int = 20
     tbptt_back_length: int = 20
 
+    def __post_init__(self):
+        if self.backprop_type not in ("standard", "tbptt"):
+            raise ValueError(
+                f"Unknown backprop_type '{self.backprop_type}' "
+                "(expected 'standard' or 'tbptt')")
+        if (self.backprop_type == "tbptt"
+                and self.tbptt_fwd_length != self.tbptt_back_length):
+            # _fit_tbptt steps and truncates by fwd_length only (same
+            # constraint as MultiLayerConfiguration.__post_init__)
+            raise ValueError(
+                "tbptt_back_length != tbptt_fwd_length is not supported: got "
+                f"fwd={self.tbptt_fwd_length}, back={self.tbptt_back_length}. "
+                "Use equal lengths")
+
     # ---- topology (reference ComputationGraph.topologicalSortOrder :1190) ----
     def topological_order(self) -> List[str]:
         indeg = {}
@@ -431,6 +445,8 @@ class GraphBuilder:
         self._outputs: List[str] = []
         self._vertices: Dict[str, Tuple[object, Tuple[str, ...]]] = {}
         self._input_types: List[InputType] = []
+        self._backprop_type = "standard"
+        self._tbptt_length = 20
 
     def add_inputs(self, *names: str) -> "GraphBuilder":
         self._inputs.extend(names)
@@ -455,6 +471,21 @@ class GraphBuilder:
         self._input_types = list(types)
         return self
 
+    def backprop_type(self, kind: str, fwd_length: int = 20,
+                      back_length: Optional[int] = None) -> "GraphBuilder":
+        """reference GraphBuilder.backpropType(...).tBPTTForwardLength(...);
+        back_length must equal fwd_length (windows step by fwd_length)."""
+        if kind not in ("standard", "tbptt"):
+            raise ValueError(f"Unknown backprop_type '{kind}' "
+                             "(expected 'standard' or 'tbptt')")
+        if back_length is not None and back_length != fwd_length:
+            raise ValueError(
+                "tbptt back_length != fwd_length is not supported: got "
+                f"fwd={fwd_length}, back={back_length}")
+        self._backprop_type = kind
+        self._tbptt_length = fwd_length
+        return self
+
     def build(self) -> ComputationGraphConfiguration:
         seed = self._parent._seed if self._parent else 12345
         dtype = self._parent._dtype if self._parent else "float32"
@@ -465,6 +496,9 @@ class GraphBuilder:
             network_outputs=tuple(self._outputs),
             input_types=tuple(self._input_types),
             seed=seed, dtype=dtype, updater=updater,
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_length,
+            tbptt_back_length=self._tbptt_length,
         )
         conf.topological_order()  # validate DAG early
         return conf

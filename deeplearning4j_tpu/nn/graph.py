@@ -66,6 +66,7 @@ class ComputationGraph:
         self.last_batch_size: Optional[int] = None
         self._score = None
         self._rng = None
+        self._rnn_carries = None
         self._jit_cache = {}
 
     # ------------------------------------------------------------------ init
@@ -100,9 +101,15 @@ class ComputationGraph:
         return None if self._score is None else float(self._score)
 
     # --------------------------------------------------------------- forward
-    def _forward(self, params, state, inputs: Sequence, train: bool, rng, masks):
+    def _forward(self, params, state, inputs: Sequence, train: bool, rng,
+                 masks, carries=None):
         """Trace the DAG. Returns (activations dict, preouts dict, new_state,
-        mask dict)."""
+        mask dict[, new_carries when ``carries`` is given]).
+
+        ``carries`` (dict vertex->carry pytree) selects the stateful
+        sequence path of recurrent layer vertices (``apply_seq``), mirroring
+        the MLN carry threading — the graph analogue of the reference's
+        rnnActivateUsingStoredState (ComputationGraph.java:2402)."""
         cdt = self._dtype
         if cdt != jnp.float32:
             params = jax.tree_util.tree_map(lambda a: a.astype(cdt), params)
@@ -114,6 +121,7 @@ class ComputationGraph:
                                            jnp.issubdtype(x.dtype, jnp.floating)) else x
             mask_of[name] = None if masks is None else masks[i]
         new_state = {}
+        new_carries = {}
         preouts = {}
         for name in self.order:
             obj, in_names = self.vertices[name]
@@ -137,6 +145,14 @@ class ComputationGraph:
                     preouts[name] = z
                     out = obj.output_activations(z)
                     new_state[name] = state[name]
+                elif (carries is not None and hasattr(obj, "apply_seq")
+                      and getattr(obj, "supports_stateful", True)):
+                    x_in = dropout_input(xs[0], obj.dropout, train, k)
+                    out, nc = obj.apply_seq(p_v, carries[name], x_in,
+                                            train=train, rng=None,
+                                            mask=in_mask)
+                    new_carries[name] = nc
+                    new_state[name] = state[name]
                 else:
                     out, st = obj.apply(p_v, state[name], xs[0],
                                         train=train, rng=k, mask=in_mask)
@@ -159,6 +175,10 @@ class ComputationGraph:
                     mask_of[name] = in_mask
                 new_state[name] = state[name]
             acts[name] = out
+        if carries is not None:
+            for n in carries:
+                new_carries.setdefault(n, carries[n])
+            return acts, preouts, new_state, mask_of, new_carries
         return acts, preouts, new_state, mask_of
 
     def _regularization(self, params):
@@ -182,9 +202,18 @@ class ComputationGraph:
         return total
 
     # ------------------------------------------------------------ train step
-    def _loss_fn(self, params, state, inputs, labels, rng, fmasks, lmasks):
-        acts, preouts, new_state, mask_of = self._forward(
-            params, state, inputs, True, rng, fmasks)
+    def _loss_fn(self, params, state, inputs, labels, rng, fmasks, lmasks,
+                 carries=None):
+        """Loss over all output layers; with ``carries`` the recurrent
+        vertices run their stateful path and the aux also returns the new
+        carries (shared by the standard and tBPTT steps)."""
+        fwd = self._forward(params, state, inputs, True, rng, fmasks, carries)
+        if carries is None:
+            acts, preouts, new_state, mask_of = fwd
+            aux = new_state
+        else:
+            acts, preouts, new_state, mask_of, new_carries = fwd
+            aux = (new_state, new_carries)
         loss = 0.0
         for j, out_name in enumerate(self.conf.network_outputs):
             layer = self.vertices[out_name][0]
@@ -195,7 +224,127 @@ class ComputationGraph:
             if lm is None:
                 lm = mask_of.get(out_name)
             loss = loss + layer.compute_score(y, preouts[out_name], lm)
-        return loss + self._regularization(params), new_state
+        return loss + self._regularization(params), aux
+
+    # ----------------------------------------------- truncated BPTT / state
+    def _zero_carries(self, batch: int):
+        return {n: (self.vertices[n][0].init_carry(batch)
+                    if hasattr(self.vertices[n][0], "init_carry") else {})
+                for n in self._layer_names}
+
+    def _loss_fn_tbptt(self, params, state, carries, inputs, labels, rng,
+                       fmasks, lmasks):
+        """Window loss with carried (but not differentiated) RNN state —
+        graph analogue of reference ComputationGraph.java:1158
+        (doTruncatedBPTT dispatch in fit)."""
+        return self._loss_fn(params, state, inputs, labels, rng, fmasks,
+                             lmasks, carries=carries)
+
+    def _make_tbptt_step(self):
+        value_and_grad = jax.value_and_grad(self._loss_fn_tbptt, has_aux=True)
+
+        def step(params, state, opt_state, carries, rng, inputs, labels,
+                 fmasks, lmasks):
+            (loss, (new_state, new_carries)), grads = value_and_grad(
+                params, state, carries, inputs, labels, rng, fmasks, lmasks)
+            new_params = dict(params)
+            new_opt = dict(opt_state)
+            for n in self._layer_names:
+                g = self._gnorms[n](grads[n])
+                updates, os = self._txs[n].update(g, opt_state[n], params[n])
+                new_params[n] = apply_constraints(
+                    self.vertices[n][0], optax.apply_updates(params[n], updates))
+                new_opt[n] = os
+            return new_params, new_state, new_opt, new_carries, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+    def _time_sliceable(self, i, x):
+        """Whether graph input i carries a time axis to window over."""
+        if x.ndim == 3:
+            return True
+        its = self.conf.input_types
+        it = its[i] if i < len(its) else None
+        return (x.ndim == 2 and it is not None and it.kind == "rnn"
+                and jnp.issubdtype(x.dtype, jnp.integer))
+
+    def _fit_tbptt(self, inputs, labels, fmasks, lmasks):
+        """Chunked fit over time windows (reference ComputationGraph.java:1158
+        doTruncatedBPTT): one optimizer update per window, RNN state carried
+        but gradients truncated at window boundaries."""
+        step = self._get_jitted("tbptt")
+        T = max(x.shape[1] for i, x in enumerate(inputs)
+                if self._time_sliceable(i, x))
+        L = self.conf.tbptt_fwd_length
+        carries = self._zero_carries(int(inputs[0].shape[0]))
+        loss = None
+        for s in range(0, T, L):
+            e = min(s + L, T)
+            xs = [x[:, s:e] if self._time_sliceable(i, x) else x
+                  for i, x in enumerate(inputs)]
+            ys = [y[:, s:e] if y.ndim == 3 else y for y in labels]
+            fms = (None if fmasks is None else
+                   [None if m is None else m[:, s:e] for m in fmasks])
+            lms = (None if lmasks is None else
+                   [None if m is None else m[:, s:e] for m in lmasks])
+            self._rng, k = jax.random.split(self._rng)
+            self.params, self.state, self.opt_state, carries, loss = step(
+                self.params, self.state, self.opt_state, carries, k,
+                xs, ys, fms, lms)
+            self._score = loss
+            self.last_batch_size = int(inputs[0].shape[0])
+            # one optimizer update per window == one iteration (MLN parity)
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration, self.epoch)
+            self.iteration += 1
+
+    def rnn_time_step(self, *inputs) -> List[np.ndarray]:
+        """Stateful step-by-step inference for recurrent graphs (reference
+        ComputationGraph.rnnTimeStep :2362): carries (h, c) across calls."""
+        for n in self._layer_names:
+            obj = self.vertices[n][0]
+            if not getattr(obj, "supports_stateful", True):
+                raise NotImplementedError(
+                    f"rnn_time_step is not supported with {type(obj).__name__}"
+                    " in vertex '" + n + "': the backward direction needs the"
+                    " full sequence")
+        xs = []
+        squeeze = False
+        for i, x in enumerate(inputs):
+            x = jnp.asarray(x)
+            its = self.conf.input_types
+            it = its[i] if i < len(its) else None
+            if it is not None and it.kind == "rnn":
+                if jnp.issubdtype(x.dtype, jnp.integer):
+                    if x.ndim == 1:     # (batch,) single timestep of ids
+                        x, squeeze = x[:, None], True
+                elif x.ndim == 2:       # (batch, features) single timestep
+                    x, squeeze = x[:, None, :], True
+            xs.append(x)
+        b = int(xs[0].shape[0])
+        if self._rnn_carries is None:
+            self._rnn_carries = self._zero_carries(b)
+        else:
+            leaves = jax.tree_util.tree_leaves(self._rnn_carries)
+            if leaves and leaves[0].shape[0] != b:
+                raise ValueError(
+                    f"rnn_time_step batch size {b} does not match stored "
+                    f"state batch {leaves[0].shape[0]}; call "
+                    "rnn_clear_previous_state() first")
+        fn = self._get_jitted("rnn_step")
+        outs, self._rnn_carries = fn(self.params, self.state,
+                                     self._rnn_carries, xs)
+        outs = [np.asarray(o) for o in outs]
+        if squeeze:
+            outs = [o[:, -1, :] if o.ndim == 3 else o for o in outs]
+        return outs
+
+    def rnn_clear_previous_state(self):
+        """reference ComputationGraph.rnnClearPreviousState."""
+        self._rnn_carries = None
+
+    def rnn_get_previous_state(self):
+        return self._rnn_carries
 
     def _make_train_step(self):
         value_and_grad = jax.value_and_grad(self._loss_fn, has_aux=True)
@@ -220,6 +369,14 @@ class ComputationGraph:
         if fn is None:
             if kind == "train":
                 fn = self._make_train_step()
+            elif kind == "tbptt":
+                fn = self._make_tbptt_step()
+            elif kind == "rnn_step":
+                def rnn_fn(params, state, carries, xs):
+                    acts, _, _, _, nc = self._forward(
+                        params, state, xs, False, None, None, carries)
+                    return [acts[n] for n in self.conf.network_outputs], nc
+                fn = jax.jit(rnn_fn)
             elif kind == "output":
                 def out_fn(params, state, inputs, fmasks):
                     acts, _, _, _ = self._forward(params, state, inputs, False,
@@ -253,13 +410,19 @@ class ComputationGraph:
         return self
 
     def _fit_batch(self, step, mds: MultiDataSet):
-        self._rng, k = jax.random.split(self._rng)
         inputs = [jnp.asarray(f) for f in mds.features]
         labels = [jnp.asarray(l) for l in mds.labels]
         fmasks = (None if mds.features_masks is None else
                   [None if m is None else jnp.asarray(m) for m in mds.features_masks])
         lmasks = (None if mds.labels_masks is None else
                   [None if m is None else jnp.asarray(m) for m in mds.labels_masks])
+        if self.conf.backprop_type == "tbptt":
+            sliceable = [x.shape[1] for i, x in enumerate(inputs)
+                         if self._time_sliceable(i, x)]
+            if sliceable and max(sliceable) > self.conf.tbptt_fwd_length:
+                self._fit_tbptt(inputs, labels, fmasks, lmasks)
+                return
+        self._rng, k = jax.random.split(self._rng)
         self.params, self.state, self.opt_state, loss = step(
             self.params, self.state, self.opt_state, k, inputs, labels, fmasks, lmasks)
         self._score = loss

@@ -245,3 +245,103 @@ def test_transfer_learning_nout_replace():
     assert new_net.params[1]["W"].shape == (20, 3)
     out = new_net.output(np.ones((2, 4), np.float32))
     assert out.shape == (2, 3)
+
+
+# ---------------------------------------------------------------------------
+# ComputationGraph recurrence: tBPTT + rnn_time_step (reference
+# ComputationGraph.java:1158 doTruncatedBPTT, :2362 rnnTimeStep;
+# ComputationGraphTestRNN.java)
+
+def _rnn_graph(tbptt=None, seed=6):
+    parent = NeuralNetConfiguration.builder()
+    parent.seed(seed).updater(Adam(5e-3)).weight_init("xavier")
+    g = GraphBuilder(parent)
+    g.add_inputs("in")
+    g.add_layer("lstm", LSTM(n_out=12, activation="tanh"), "in")
+    g.add_layer("out", RnnOutputLayer(n_out=4, activation="softmax",
+                                      loss="mcxent"), "lstm")
+    g.set_outputs("out")
+    g.set_input_types(InputType.recurrent(4))
+    if tbptt:
+        g.backprop_type("tbptt", fwd_length=tbptt)
+    return ComputationGraph(g.build()).init()
+
+
+def test_graph_tbptt_matches_mln():
+    """A linear LSTM graph under tBPTT must replicate the MLN tBPTT path
+    exactly (same seed => same init => identical scores and windows)."""
+    rng = np.random.default_rng(7)
+    idx = rng.integers(0, 4, (8, 20))
+    x = np.eye(4, dtype=np.float32)[idx]
+    y = x.copy()
+
+    net = _rnn_graph(tbptt=5)
+    mln_conf = (NeuralNetConfiguration.builder()
+                .seed(6).updater(Adam(5e-3)).weight_init("xavier").list()
+                .layer(LSTM(n_out=12, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=4, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(4))
+                .backprop_type("tbptt", fwd_length=5, back_length=5)
+                .build())
+    mln = MultiLayerNetwork(mln_conf).init()
+
+    ds = DataSet(x, y)
+    s_g0 = net.score_dataset(ds)
+    s_m0 = mln.score_dataset(ds)
+    np.testing.assert_allclose(s_g0, s_m0, rtol=1e-5)
+
+    for _ in range(10):
+        net.fit(ds)
+        mln.fit(ds)
+    assert net.iteration == 10 * 4  # 20 steps / 5 per window
+    s_g1 = net.score_dataset(ds)
+    s_m1 = mln.score_dataset(ds)
+    assert s_g1 < s_g0 * 0.8
+    np.testing.assert_allclose(s_g1, s_m1, rtol=2e-3)
+
+
+def test_graph_rnn_time_step_matches_full_forward():
+    net = _rnn_graph()
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((2, 6, 4)).astype(np.float32)
+    full = net.output_single(x)
+    net.rnn_clear_previous_state()
+    step_outs = [net.rnn_time_step(x[:, t, :])[0] for t in range(6)]
+    np.testing.assert_allclose(np.stack(step_outs, axis=1), full,
+                               rtol=2e-4, atol=1e-5)
+    # chunked: 2 steps then 4, carried across calls
+    net.rnn_clear_previous_state()
+    o1 = net.rnn_time_step(x[:, :2, :])[0]
+    o2 = net.rnn_time_step(x[:, 2:, :])[0]
+    np.testing.assert_allclose(np.concatenate([o1, o2], axis=1), full,
+                               rtol=2e-4, atol=1e-5)
+    # state bookkeeping (reference rnnGetPreviousState)
+    assert net.rnn_get_previous_state() is not None
+    net.rnn_clear_previous_state()
+    assert net.rnn_get_previous_state() is None
+
+
+def test_graph_tbptt_multi_input():
+    """tBPTT over a two-input recurrent DAG: both sequence inputs window
+    together; the static-shape merge trains."""
+    parent = NeuralNetConfiguration.builder()
+    parent.seed(3).updater(Adam(5e-3)).weight_init("xavier")
+    g = GraphBuilder(parent)
+    g.add_inputs("a", "b")
+    g.add_vertex("merge", MergeVertex(), "a", "b")
+    g.add_layer("lstm", LSTM(n_out=8, activation="tanh"), "merge")
+    g.add_layer("out", RnnOutputLayer(n_out=3, activation="softmax",
+                                      loss="mcxent"), "lstm")
+    g.set_outputs("out")
+    g.set_input_types(InputType.recurrent(2), InputType.recurrent(3))
+    g.backprop_type("tbptt", fwd_length=4)
+    net = ComputationGraph(g.build()).init()
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((4, 12, 2)).astype(np.float32)
+    b = rng.standard_normal((4, 12, 3)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (4, 12))]
+    mds = MultiDataSet([a, b], [y])
+    net.fit(mds)
+    assert net.iteration == 3  # 12 / 4 windows
+    assert net.score() is not None and np.isfinite(net.score())

@@ -30,19 +30,16 @@ def bucket(name):
     if "reduce" in head:
         return "other-reduce"
     if "fusion" in head:
-        # classify fusions by their output dtype/shape scale
+        # classify fusions by their first output's dtype and rank (rank<=1
+        # f32 outputs are per-leaf optimizer/param updates; higher-rank f32
+        # outputs are dW convs and their fused consumers)
         m2 = re.match(r"%\S+ = \(?((?:bf16|f32|s32|pred|u32)\[[^\]]*\])", name)
         out = m2.group(1) if m2 else "?"
-        if out.startswith("f32[") and ",“" not in out:
-            return f"fusion-f32-small" if "]" in out and out.count(",") <= 1 \
-                else "fusion-f32-big"
+        if out.startswith("f32["):
+            return ("fusion-f32-small" if out.count(",") <= 1
+                    else "fusion-f32-big")
         return "fusion-" + (out[:4] if out != "?" else "?")
     return kind
-
-
-def dims(out):
-    inner = out.split("[", 1)[1].rstrip("]")
-    return [int(d) for d in inner.split(",") if d.strip().isdigit()]
 
 
 def main():
