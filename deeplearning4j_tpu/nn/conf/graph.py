@@ -368,13 +368,18 @@ class ComputationGraphConfiguration:
             else:
                 types[name] = its
                 known[name] = obj.output_type(*its)
-        return types, pres
+        return types, pres, known
 
     def vertex_input_types(self) -> Dict[str, Tuple[InputType, ...]]:
         return self._infer()[0]
 
     def resolved_vertex_preprocessors(self):
         return self._infer()[1]
+
+    def vertex_output_types(self) -> Dict[str, InputType]:
+        """Output InputType of every vertex (and network input) — used by
+        transfer learning to type the frozen boundary."""
+        return self._infer()[2]
 
     def wired_vertices(self) -> Dict[str, Tuple[object, Tuple[str, ...]]]:
         types = self.vertex_input_types()

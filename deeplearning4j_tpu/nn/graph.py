@@ -318,6 +318,8 @@ class ComputationGraph:
                 if jnp.issubdtype(x.dtype, jnp.integer):
                     if x.ndim == 1:     # (batch,) single timestep of ids
                         x, squeeze = x[:, None], True
+                    elif x.ndim == 2 and x.shape[1] == 1:
+                        squeeze = True  # (batch, 1) ids: MLN parity
                 elif x.ndim == 2:       # (batch, features) single timestep
                     x, squeeze = x[:, None, :], True
             xs.append(x)

@@ -345,3 +345,118 @@ def test_graph_tbptt_multi_input():
     net.fit(mds)
     assert net.iteration == 3  # 12 / 4 windows
     assert net.score() is not None and np.isfinite(net.score())
+
+
+# ---------------------------------------------------------------------------
+# Graph transfer learning (reference TransferLearning.java:447 GraphBuilder,
+# TransferLearningHelper.java graph half)
+
+def _tiny_resnetish(seed=9, num_classes=5):
+    """Small conv graph shaped like the zoo models (conv trunk + classifier)."""
+    from deeplearning4j_tpu.nn.conf.convolutional import (
+        ConvolutionLayer, SubsamplingLayer,
+    )
+    from deeplearning4j_tpu.nn.conf.layers import ActivationLayer
+    from deeplearning4j_tpu.nn.conf.pooling import GlobalPoolingLayer
+    parent = NeuralNetConfiguration.builder()
+    parent.seed(seed).updater(Adam(1e-2)).weight_init("relu")
+    g = GraphBuilder(parent)
+    g.add_inputs("in")
+    g.add_layer("c1", ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                       convolution_mode="same",
+                                       activation="relu"), "in")
+    g.add_layer("p1", SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)), "c1")
+    g.add_layer("c2", ConvolutionLayer(n_out=12, kernel_size=(3, 3),
+                                       convolution_mode="same",
+                                       activation="relu"), "p1")
+    g.add_layer("gap", GlobalPoolingLayer(pooling_type="avg"), "c2")
+    g.add_layer("fc", OutputLayer(n_out=num_classes, activation="softmax",
+                                  loss="mcxent"), "gap")
+    g.set_outputs("fc")
+    g.set_input_types(InputType.convolutional(16, 16, 3))
+    return ComputationGraph(g.build()).init()
+
+
+def _cifar_shape_data(n=32, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 16, 16, 3)).astype(np.float32)
+    # learnable: class = argmax of per-channel mean
+    y_idx = np.argmax(x.mean(axis=(1, 2)), axis=-1) % classes
+    y = np.eye(classes, dtype=np.float32)[y_idx]
+    return x, y
+
+
+def test_graph_transfer_learning_freeze_replace(tmp_path):
+    """Save -> restore -> freeze trunk -> replace classifier -> fine-tune:
+    the reference's marquee workflow (TransferLearning.java GraphBuilder)."""
+    from deeplearning4j_tpu.utils.serialization import write_model, restore
+
+    net = _tiny_resnetish()
+    path = str(tmp_path / "g.zip")
+    write_model(net, path)
+    loaded = restore(path)
+
+    tl = (TransferLearning.GraphBuilder(loaded)
+          .fine_tune_configuration(FineTuneConfiguration(updater=Adam(5e-3)))
+          .set_feature_extractor("gap")
+          .remove_vertex_and_connections("fc")
+          .add_layer("fc_new", OutputLayer(n_out=3, activation="softmax",
+                                           loss="mcxent"), "gap")
+          .set_outputs("fc_new")
+          .build())
+
+    # trunk params copied from the trained net
+    np.testing.assert_array_equal(np.asarray(tl.params["c1"]["W"]),
+                                  np.asarray(loaded.params["c1"]["W"]))
+    x, _ = _cifar_shape_data()
+    # labels derivable from the frozen trunk's own features: guaranteed
+    # learnable by the new head alone
+    from deeplearning4j_tpu.nn.transferlearning import TransferLearningHelper
+    feats = TransferLearningHelper(loaded, "gap").featurize(x)[0]
+    y = np.eye(3, dtype=np.float32)[np.argmax(feats[:, :3], axis=-1)]
+    mds = MultiDataSet([x], [y])
+    frozen_before = np.asarray(tl.params["c1"]["W"]).copy()
+    s0 = tl.score_dataset(mds)
+    tl.fit(mds, num_epochs=60)
+    s1 = tl.score_dataset(mds)
+    assert s1 < s0 * 0.7, (s0, s1)
+    # frozen trunk must not move; new head must train
+    np.testing.assert_array_equal(np.asarray(tl.params["c1"]["W"]), frozen_before)
+
+
+def test_graph_transfer_learning_nout_replace():
+    net = _tiny_resnetish()
+    tl = (TransferLearning.GraphBuilder(net)
+          .n_out_replace("fc", 7)
+          .build())
+    x, _ = _cifar_shape_data()
+    out = tl.output_single(x)
+    assert out.shape == (32, 7)
+    # c1 kept, fc re-initialized
+    np.testing.assert_array_equal(np.asarray(tl.params["c1"]["W"]),
+                                  np.asarray(net.params["c1"]["W"]))
+
+
+def test_graph_transfer_learning_helper_featurize():
+    """Helper: featurize at the frozen boundary and train only the tail
+    (reference TransferLearningHelper.fitFeaturized)."""
+    from deeplearning4j_tpu.nn.transferlearning import TransferLearningHelper
+
+    net = _tiny_resnetish()
+    helper = TransferLearningHelper(net, "gap")
+    x, y = _cifar_shape_data()
+    feats = helper.featurize(x)
+    assert feats[0].shape == (32, 12)  # gap pools c2's 12 channels
+    sub = helper.unfrozen_graph()
+    # the sub-graph's fc params start as the parent's
+    np.testing.assert_array_equal(np.asarray(sub.params["fc"]["W"]),
+                                  np.asarray(net.params["fc"]["W"]))
+    y5 = np.eye(5, dtype=np.float32)[np.argmax(feats[0][:, :5], axis=-1)]
+    s0 = sub.score_dataset(MultiDataSet([feats[0]], [y5]))
+    sub = helper.fit_featurized(feats[0], y5, num_epochs=80)
+    s1 = sub.score_dataset(MultiDataSet([feats[0]], [y5]))
+    assert s1 < s0 * 0.7, (s0, s1)
+    # reference parity: fitFeaturized mutates the ORIGINAL graph's unfrozen
+    # layers — the trained head must be folded back into the full net
+    np.testing.assert_array_equal(np.asarray(net.params["fc"]["W"]),
+                                  np.asarray(sub.params["fc"]["W"]))
