@@ -7,5 +7,7 @@ Parity surface: reference ``deeplearning4j-graph/`` —
 
 from deeplearning4j_tpu.graphs.graph import Graph
 from deeplearning4j_tpu.graphs.deepwalk import DeepWalk, RandomWalkIterator
+from deeplearning4j_tpu.graphs.node2vec import Node2Vec, Node2VecWalkIterator
 
-__all__ = ["Graph", "DeepWalk", "RandomWalkIterator"]
+__all__ = ["Graph", "DeepWalk", "RandomWalkIterator", "Node2Vec",
+           "Node2VecWalkIterator"]

@@ -75,10 +75,14 @@ class DeepWalk:
         self._vectors: Optional[SequenceVectors] = None
         self.num_vertices = 0
 
+    def _make_walk_iterator(self, graph: Graph, walk_length: int):
+        """Hook for subclasses with different transition rules (Node2Vec)."""
+        return RandomWalkIterator(graph, walk_length, seed=self.seed)
+
     def fit(self, graph: Graph, walk_length: Optional[int] = None) -> "DeepWalk":
         """Generate walks and train (reference DeepWalk.fit(IGraph, int))."""
         L = walk_length or self.walk_length
-        it = RandomWalkIterator(graph, L, seed=self.seed)
+        it = self._make_walk_iterator(graph, L)
         sequences: List[List[str]] = []
         for rep in range(self.walks_per_vertex):
             sequences.extend([[str(v) for v in walk] for walk in it.walks(rep)])

@@ -1,0 +1,83 @@
+"""Node2Vec vertex embeddings.
+
+Parity surface: reference
+``deeplearning4j-nlp/.../models/node2vec/Node2Vec.java:34`` (p/q-biased
+second-order random walks feeding the SequenceVectors machinery; Grover &
+Leskovec 2016).
+
+Like DeepWalk, the walks lower to token sequences trained with the jitted
+SequenceVectors SGNS kernels; only the walk generator differs — the
+return parameter ``p`` (likelihood of revisiting the previous vertex) and
+in-out parameter ``q`` (BFS- vs DFS-like exploration) bias each transition:
+
+  alpha = 1/p if next == prev; 1 if next is a neighbour of prev; else 1/q
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from deeplearning4j_tpu.graphs.deepwalk import DeepWalk
+from deeplearning4j_tpu.graphs.graph import Graph
+
+
+class Node2VecWalkIterator:
+    """Second-order biased walks, one starting at every vertex per epoch.
+    Disconnected vertices self-loop (same NO_EDGE_HANDLING as DeepWalk)."""
+
+    def __init__(self, graph: Graph, walk_length: int, p: float = 1.0,
+                 q: float = 1.0, seed: int = 123, weighted: bool = False):
+        self.graph = graph
+        self.walk_length = walk_length
+        self.p = float(p)
+        self.q = float(q)
+        self.seed = seed
+        self.weighted = weighted
+        # adjacency sets for O(1) "is next a neighbour of prev" tests
+        self._nbr_sets = [set(graph.connected_vertices(v))
+                          for v in range(graph.num_vertices)]
+
+    def walks(self, epoch: int = 0) -> List[List[int]]:
+        rng = np.random.default_rng(self.seed + epoch)
+        order = rng.permutation(self.graph.num_vertices)
+        out = []
+        for start in order:
+            v = int(start)
+            walk = [v]
+            prev = None
+            for _ in range(self.walk_length - 1):
+                nbrs = self.graph.connected_vertices(v)
+                if not nbrs:
+                    walk.append(v)  # SELF_LOOP_ON_DISCONNECTED
+                    prev = v
+                    continue
+                w = (np.asarray(self.graph.edge_weights(v), np.float64)
+                     if self.weighted else np.ones(len(nbrs), np.float64))
+                if prev is not None:
+                    prev_nbrs = self._nbr_sets[prev]
+                    alpha = np.array(
+                        [1.0 / self.p if nb == prev
+                         else (1.0 if nb in prev_nbrs else 1.0 / self.q)
+                         for nb in nbrs], np.float64)
+                    w = w * alpha
+                nxt = int(rng.choice(np.asarray(nbrs), p=w / w.sum()))
+                walk.append(nxt)
+                prev, v = v, nxt
+            out.append(walk)
+        return out
+
+
+class Node2Vec(DeepWalk):
+    """DeepWalk with p/q-biased transitions (reference Node2Vec.java:34;
+    p=q=1 reduces exactly to DeepWalk's uniform walks)."""
+
+    def __init__(self, p: float = 1.0, q: float = 1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.p = p
+        self.q = q
+
+    def _make_walk_iterator(self, graph: Graph, walk_length: int):
+        return Node2VecWalkIterator(graph, walk_length, p=self.p, q=self.q,
+                                    seed=self.seed)

@@ -36,6 +36,9 @@ from deeplearning4j_tpu.nlp.sentenceiterator import (
 )
 from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.cnn_sentence import (
+    CnnSentenceDataSetIterator, CollectionLabeledSentenceProvider,
+)
 from deeplearning4j_tpu.nlp.paragraphvectors import ParagraphVectors
 from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.serializer import StaticWordVectors, WordVectorSerializer
@@ -50,7 +53,9 @@ __all__ = [
     "BagOfWordsVectorizer",
     "BaseTextVectorizer",
     "BasicLineIterator",
+    "CollectionLabeledSentenceProvider",
     "CollectionSentenceIterator",
+    "CnnSentenceDataSetIterator",
     "CommonPreprocessor",
     "DefaultTokenizerFactory",
     "Glove",

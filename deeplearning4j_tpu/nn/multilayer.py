@@ -54,6 +54,15 @@ class MultiLayerNetwork:
             (l.updater if getattr(l, "updater", None) is not None else conf.updater).to_optax()
             for l in self.layers
         ]
+        # whether each layer's OUTPUT still has a time axis the feature mask
+        # applies to; a per-step mask must not survive layers that collapse
+        # time (cnn/ff) or it breaks the loss shape (graph.py does the same)
+        try:
+            self._mask_survives = [
+                l.output_type(it).kind in ("rnn", "cnn1d")
+                for l, it in zip(self.layers, conf.layer_input_types())]
+        except Exception:
+            self._mask_survives = [True] * len(self.layers)
         self._gnorms = [
             gradient_normalization(getattr(l, "gradient_normalization", None),
                                    getattr(l, "gradient_normalization_threshold", 1.0))
@@ -153,6 +162,8 @@ class MultiLayerNetwork:
                 x, st = layer.apply(p_i, state[i], x, train=train, rng=k, mask=cur_mask)
                 new_state.append(st)
                 new_carries.append({})
+            if not self._mask_survives[i]:
+                cur_mask = None
             acts.append(x)
         return acts, preout, new_state, cur_mask, new_carries
 

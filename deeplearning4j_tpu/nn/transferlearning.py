@@ -195,24 +195,21 @@ class TransferLearning:
 
         # ---- surgery --------------------------------------------------
         def remove_vertex_and_connections(self, name: str):
-            """Remove the vertex and every vertex that (transitively)
-            depends on it; removed names are dropped from the outputs
-            (reference removeVertexAndConnections)."""
+            """Remove the vertex and its edges: consumers drop it from
+            their input lists but otherwise survive (reference
+            removeVertexAndConnections — downstream vertices are left for
+            the caller to re-wire; a consumer left with no inputs fails
+            DAG validation at build() with a clear error)."""
             if name not in self._vertices:
                 raise KeyError(f"Unknown vertex '{name}'")
-            doomed = {name}
-            changed = True
-            while changed:
-                changed = False
-                for n, (_, ins) in self._vertices.items():
-                    if n not in doomed and any(i in doomed for i in ins):
-                        doomed.add(n)
-                        changed = True
-            for n in doomed:
-                del self._vertices[n]
-                self._keep.pop(n, None)
-                self._frozen.discard(n)
-            self._outputs = [o for o in self._outputs if o not in doomed]
+            del self._vertices[name]
+            self._keep.pop(name, None)
+            self._frozen.discard(name)
+            for n, (obj, ins) in list(self._vertices.items()):
+                if name in ins:
+                    self._vertices[n] = (
+                        obj, tuple(i for i in ins if i != name))
+            self._outputs = [o for o in self._outputs if o != name]
             return self
 
         def remove_vertex_keep_connections(self, name: str):

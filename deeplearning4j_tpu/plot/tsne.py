@@ -2,16 +2,27 @@
 
 Parity surface: reference ``deeplearning4j-core/.../plot/BarnesHutTsne.java:65``
 (builder: theta, perplexity, maxIter, learningRate, momentum/finalMomentum,
-stopLyingIteration; ``fit(INDArray)`` then ``getData()``) and ``Tsne.java``.
+stopLyingIteration; ``fit(INDArray)`` then ``getData()``), ``Tsne.java``, and
+the approximation machinery ``sptree/SpTree.java:36`` / ``QuadTree.java``.
 
-TPU-native design: Barnes-Hut trades exactness for an O(N log N) *host*
-quadtree — pointer chasing that cannot map to the MXU. Here every gradient
-iteration is ONE jitted XLA program over full (N, N) matrices: the pairwise
-distance matrices are matmul-shaped (MXU), and the van-der-Maaten update
-rules (momentum schedule, per-dimension gains, early exaggeration) run
-elementwise on-device. For the N where t-SNE is practical (~50k points the
-reference cites), dense MXU FLOPs beat a serial quadtree; ``theta`` is
-accepted for API parity and ignored (exactness is strictly better).
+TPU-native design, two regimes:
+
+- **exact** (small/medium n, or ``theta == 0``): every gradient iteration is
+  ONE jitted XLA program over full (N, N) matrices — distance matrices are
+  matmul-shaped (MXU), the van-der-Maaten update rules (momentum schedule,
+  per-dimension gains, early exaggeration) run elementwise on-device.
+
+- **approximate** (``theta > 0`` and n >= ``bh_threshold``): the reference's
+  dual-tree Barnes-Hut is pointer chasing that cannot map to the MXU. The
+  TPU equivalent keeps the SAME two approximations in vectorized form:
+  (a) attractive forces over a sparse kNN graph (k = 3*perplexity, exactly
+  the sparse P of BarnesHutTsne.java), built by a device-tiled streaming
+  top-k over MXU distance blocks; (b) repulsive forces against the mass
+  centroids of a fixed 64x64 (2-D) embedding grid — the fixed-resolution
+  analogue of the quadtree's far-field cells, with O(n * cells) work tiled
+  to bound memory. Memory is O(n*k + cells) per iteration at ANY n, never
+  O(n^2).
+
 Perplexity calibration is a vectorized binary search over all rows at once.
 """
 
@@ -86,18 +97,209 @@ def _tsne_step(y, p, gains, velocity, momentum, lr):
     return y, gains, velocity, kl
 
 
+# ---------------------------------------------------------------------------
+# Approximate (Barnes-Hut-equivalent) machinery
+
+def _knn_graph(x: np.ndarray, k: int, row_tile: int = 2048,
+               col_chunk: int = 8192):
+    """Device-tiled k-nearest-neighbours: returns (idx (n, k) int32,
+    d2 (n, k) float32). Streaming top-k over MXU distance blocks — memory is
+    O(row_tile * col_chunk), never O(n^2)."""
+    n, _ = x.shape
+    k = min(k, n - 1)
+    xd = jnp.asarray(x, jnp.float32)
+    sq = jnp.sum(xd * xd, 1)
+    n_cols = -(-n // col_chunk) * col_chunk
+    pad_c = n_cols - n
+    xc = jnp.pad(xd, ((0, pad_c), (0, 0)))
+    sqc = jnp.pad(sq, (0, pad_c))
+
+    @functools.partial(jax.jit, static_argnums=())
+    def tile(rows, rows_sq, row0):
+        best_d = jnp.full((rows.shape[0], k), jnp.inf, jnp.float32)
+        best_i = jnp.zeros((rows.shape[0], k), jnp.int32)
+        for c0 in range(0, n_cols, col_chunk):
+            cols = jax.lax.dynamic_slice_in_dim(xc, c0, col_chunk)
+            csq = jax.lax.dynamic_slice_in_dim(sqc, c0, col_chunk)
+            d2 = (rows_sq[:, None] + csq[None, :]
+                  - 2.0 * jnp.matmul(rows, cols.T, precision="highest"))
+            gcol = c0 + jnp.arange(col_chunk)
+            # mask padding columns and self-distances
+            bad = (gcol[None, :] >= n) | (gcol[None, :] ==
+                                          (row0 + jnp.arange(rows.shape[0]))[:, None])
+            d2 = jnp.where(bad, jnp.inf, d2)
+            cat_d = jnp.concatenate([best_d, d2], 1)
+            cat_i = jnp.concatenate(
+                [best_i, jnp.broadcast_to(gcol, d2.shape).astype(jnp.int32)], 1)
+            negs, args = jax.lax.top_k(-cat_d, k)
+            best_d = -negs
+            best_i = jnp.take_along_axis(cat_i, args, axis=1)
+        return best_d, best_i
+
+    idx = np.zeros((n, k), np.int32)
+    d2 = np.zeros((n, k), np.float32)
+    n_rows = -(-n // row_tile) * row_tile
+    xr = jnp.pad(xd, ((0, n_rows - n), (0, 0)))
+    sqr = jnp.pad(sq, (0, n_rows - n))
+    for r0 in range(0, n_rows, row_tile):
+        bd, bi = tile(jax.lax.dynamic_slice_in_dim(xr, r0, row_tile),
+                      jax.lax.dynamic_slice_in_dim(sqr, r0, row_tile),
+                      jnp.int32(r0))
+        take = min(row_tile, n - r0)
+        d2[r0:r0 + take] = np.asarray(bd[:take])
+        idx[r0:r0 + take] = np.asarray(bi[:take])
+    return idx, d2
+
+
+def _knn_probs(d2: np.ndarray, perplexity: float, tol: float = 1e-5,
+               max_steps: int = 50) -> np.ndarray:
+    """Row-stochastic P(j|i) over the kNN distances only (the sparse P of
+    BarnesHutTsne.java computeGaussianPerplexity with its VPTree kNN)."""
+    n, k = d2.shape
+    log_target = np.log(min(perplexity, k))
+    beta = np.ones(n)
+    beta_min = np.full(n, -np.inf)
+    beta_max = np.full(n, np.inf)
+    d2 = d2 - d2[:, :1]  # shift for numerical stability (exp overflow)
+    p = np.zeros_like(d2)
+    for _ in range(max_steps):
+        p = np.exp(-d2 * beta[:, None])
+        psum = np.maximum(p.sum(1), 1e-12)
+        h = np.log(psum) + beta * np.sum(d2 * p, 1) / psum
+        diff = h - log_target
+        done = np.abs(diff) < tol
+        if done.all():
+            break
+        too_high = diff > 0
+        beta_min = np.where(too_high & ~done, beta, beta_min)
+        beta_max = np.where(~too_high & ~done, beta, beta_max)
+        beta = np.where(
+            too_high & ~done,
+            np.where(np.isinf(beta_max), beta * 2, (beta + beta_max) / 2),
+            np.where(~too_high & ~done,
+                     np.where(np.isinf(beta_min), beta / 2, (beta + beta_min) / 2),
+                     beta))
+    return p / np.maximum(p.sum(1, keepdims=True), 1e-12)
+
+
+def _symmetrize_sparse(idx: np.ndarray, p: np.ndarray):
+    """(P + P^T) / 2n over sparse COO, repacked to padded per-row lists.
+    Returns (nbr_idx (n, K2) int32, nbr_val (n, K2) float32).
+
+    K2 is capped at 3k: kNN *hub* points can be reverse-neighbours of
+    thousands of rows, and padding every row to the hub width explodes
+    memory (seen: K2=2127 at n=100k). Rows over the cap keep their
+    largest-p entries — the dropped tail is the smallest conditional
+    probabilities, negligible attractive mass."""
+    n, k = idx.shape
+    rows = np.repeat(np.arange(n, dtype=np.int64), k)
+    cols = idx.reshape(-1).astype(np.int64)
+    vals = p.reshape(-1) / (2.0 * n)
+    r = np.concatenate([rows, cols])
+    c = np.concatenate([cols, rows])
+    v = np.concatenate([vals, vals])
+    key = r * n + c
+    order = np.argsort(key, kind="stable")
+    key, v = key[order], v[order]
+    uniq, start = np.unique(key, return_index=True)
+    summed = np.add.reduceat(v, start)
+    ur = (uniq // n).astype(np.int64)
+    uc = (uniq % n).astype(np.int32)
+    # order by (row, -value) so per-row slots are value-sorted
+    order2 = np.lexsort((-summed, ur))
+    ur, uc, summed = ur[order2], uc[order2], summed[order2]
+    counts = np.bincount(ur, minlength=n)
+    cap = 3 * k
+    K2 = int(min(counts.max(), cap))
+    # within-row position of each entry, vectorized (a per-row arange
+    # concat is O(n) python objects at the large n this path exists for)
+    slot = (np.arange(counts.sum(), dtype=np.int64)
+            - np.repeat(np.cumsum(counts, dtype=np.int64) - counts, counts))
+    keep = slot < K2
+    nbr_idx = np.zeros((n, K2), np.int32)
+    nbr_val = np.zeros((n, K2), np.float32)
+    nbr_idx[ur[keep], slot[keep]] = uc[keep]
+    nbr_val[ur[keep], slot[keep]] = summed[keep]
+    return nbr_idx, nbr_val
+
+
+def _make_bh_step(n_pad: int, dim: int, grid: int, row_tile: int):
+    """Jitted approximate gradient step. Points are padded to n_pad with a
+    0/1 weight vector; the repulsive field is evaluated against the mass
+    centroids of a grid^dim cell decomposition of the current embedding."""
+    cells = grid ** dim
+
+    @jax.jit
+    def step(y, wpt, nbr_idx, nbr_val, gains, velocity, momentum, lr):
+        # ---- attractive: sparse kNN pairs, O(n*k)
+        yj = y[nbr_idx]                                    # (n, K2, dim)
+        diff = y[:, None, :] - yj
+        w = 1.0 / (1.0 + jnp.sum(diff * diff, -1))         # (n, K2)
+        f_attr = jnp.einsum("nk,nkd->nd", nbr_val * w, diff)
+        # ---- repulsive: grid-centroid far field, O(n*cells) tiled
+        big = 1e9
+        ymasked = jnp.where(wpt[:, None] > 0, y, big)      # pads out of range
+        mn = jnp.min(ymasked, 0)
+        mx = jnp.max(jnp.where(wpt[:, None] > 0, y, -big), 0)
+        span = jnp.maximum(mx - mn, 1e-9)
+        cellc = jnp.clip(((y - mn) / span * grid).astype(jnp.int32), 0, grid - 1)
+        cid = cellc[:, 0]
+        for d in range(1, dim):
+            cid = cid * grid + cellc[:, d]
+        cid = jnp.where(wpt > 0, cid, cells - 1)
+        m = jax.ops.segment_sum(wpt, cid, cells)
+        s = jax.ops.segment_sum(y * wpt[:, None], cid, cells)
+        mu = s / jnp.maximum(m, 1.0)[:, None]
+
+        def tile_fn(yt):
+            dif = yt[:, None, :] - mu[None, :, :]          # (T, cells, dim)
+            wq = 1.0 / (1.0 + jnp.sum(dif * dif, -1))      # (T, cells)
+            z_part = jnp.sum(wq * m[None, :], 1) - 1.0     # minus self w_ii
+            f = jnp.einsum("tc,tcd->td", wq * wq * m[None, :], dif)
+            return z_part, f
+
+        zs, fs = jax.lax.map(tile_fn, y.reshape(n_pad // row_tile, row_tile,
+                                                dim))
+        z = jnp.maximum(jnp.sum(zs.reshape(-1) * wpt), 1e-12)
+        f_rep = fs.reshape(n_pad, dim)
+        grad = 4.0 * (f_attr - f_rep / z)
+        grad = grad * wpt[:, None]
+        same_sign = jnp.sign(grad) == jnp.sign(velocity)
+        gains = jnp.maximum(jnp.where(same_sign, gains * 0.8, gains + 0.2),
+                            0.01)
+        velocity = momentum * velocity - lr * gains * grad
+        y = y + velocity * wpt[:, None]
+        npts = jnp.maximum(jnp.sum(wpt), 1.0)
+        y = y - (jnp.sum(y * wpt[:, None], 0) / npts)
+        # approximate KL over the stored neighbour pairs
+        q = jnp.maximum(w / z, 1e-12)
+        kl = jnp.sum(jnp.where(nbr_val > 0,
+                               nbr_val * jnp.log(
+                                   jnp.maximum(nbr_val, 1e-12) / q), 0.0))
+        return y, gains, velocity, kl
+
+    return step
+
+
 class BarnesHutTsne:
-    """Exact-on-TPU t-SNE with the reference's builder surface."""
+    """t-SNE with the reference's builder surface: exact on the MXU for
+    small n (or theta=0), kNN + grid-centroid approximation (the reference's
+    Barnes-Hut regime) for large n."""
 
     def __init__(self, num_dimensions: int = 2, perplexity: float = 30.0,
                  theta: float = 0.5, max_iter: int = 1000,
                  learning_rate: float = 200.0, momentum: float = 0.5,
                  final_momentum: float = 0.8, switch_momentum_iteration: int = 250,
                  stop_lying_iteration: int = 250, exaggeration: float = 12.0,
-                 seed: int = 123):
+                 seed: int = 123, bh_threshold: int = 8192,
+                 grid: int = 0):
         self.num_dimensions = num_dimensions
         self.perplexity = perplexity
-        self.theta = theta  # accepted for parity; exact gradients are used
+        # theta == 0 forces exact gradients at any n (reference semantics);
+        # theta > 0 selects the approximate regime once n >= bh_threshold
+        self.theta = theta
+        self.bh_threshold = bh_threshold
+        self.grid = grid or (64 if num_dimensions <= 2 else 16)
         self.max_iter = max_iter
         self.learning_rate = learning_rate
         self.momentum = momentum
@@ -116,6 +318,8 @@ class BarnesHutTsne:
             raise ValueError(
                 f"Perplexity {self.perplexity} too large for {n} points "
                 "(need n-1 >= 3*perplexity)")
+        if self.theta > 0 and n >= self.bh_threshold:
+            return self._fit_bh(x)
         p = _conditional_probs(x, self.perplexity)
         p = (p + p.T) / (2.0 * n)          # symmetrize, joint distribution
         p = np.maximum(p, 1e-12)
@@ -136,6 +340,42 @@ class BarnesHutTsne:
             if it % 50 == 0 or it == self.max_iter - 1:
                 self.kl_history.append(float(kl))
         self.embedding = np.asarray(y)
+        return self
+
+    def _fit_bh(self, x: np.ndarray) -> "BarnesHutTsne":
+        """Approximate regime: sparse kNN attraction + grid-centroid
+        repulsion (see module docstring). Memory O(n*k + cells)."""
+        n = x.shape[0]
+        k = max(3, int(3 * self.perplexity))
+        idx, d2 = _knn_graph(x, k)
+        p_cond = _knn_probs(d2, self.perplexity)
+        nbr_idx, nbr_val = _symmetrize_sparse(idx, p_cond)
+        row_tile = 1024
+        n_pad = -(-n // row_tile) * row_tile
+        dim = self.num_dimensions
+        step = _make_bh_step(n_pad, dim, self.grid, row_tile)
+        key = jax.random.key(self.seed)
+        y = 1e-4 * jax.random.normal(key, (n_pad, dim), jnp.float32)
+        wpt = jnp.asarray(
+            np.pad(np.ones(n, np.float32), (0, n_pad - n)))
+        nbr_idx_d = jnp.asarray(np.pad(nbr_idx, ((0, n_pad - n), (0, 0))))
+        val_np = np.pad(nbr_val, ((0, n_pad - n), (0, 0)))
+        gains = jnp.ones_like(y)
+        velocity = jnp.zeros_like(y)
+        self.kl_history = []
+        val_plain = jnp.asarray(val_np)
+        val_lying = jnp.asarray(val_np * self.exaggeration)
+        for it in range(self.max_iter):
+            lying = it < self.stop_lying_iteration
+            mom = (self.momentum if it < self.switch_momentum_iteration
+                   else self.final_momentum)
+            y, gains, velocity, kl = step(
+                y, wpt, nbr_idx_d, val_lying if lying else val_plain,
+                gains, velocity, jnp.float32(mom),
+                jnp.float32(self.learning_rate))
+            if it % 50 == 0 or it == self.max_iter - 1:
+                self.kl_history.append(float(kl))
+        self.embedding = np.asarray(y[:n])
         return self
 
     def fit_transform(self, x) -> np.ndarray:

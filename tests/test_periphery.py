@@ -177,3 +177,122 @@ def test_deepwalk_embeds_cliques():
     assert intra > inter
     near = dw.verts_nearest(2, top_n=4)
     assert set(near) <= set(range(6))  # all neighbors from the same clique
+
+
+def test_barnes_hut_tsne_matches_exact():
+    """Approximate (kNN + grid-centroid) regime: KL within tolerance of the
+    exact solver and equivalent cluster separation (reference
+    BarnesHutTsne.java:65 / SpTree.java:36 approximation contract)."""
+    from deeplearning4j_tpu.plot.tsne import BarnesHutTsne
+
+    rng = np.random.default_rng(0)
+    n_per = 150
+    cents = 8.0 * np.eye(3, 10)
+    x = np.concatenate([c + rng.standard_normal((n_per, 10)) for c in cents])
+    labels = np.repeat(np.arange(3), n_per)
+
+    exact = BarnesHutTsne(max_iter=300, perplexity=20, seed=3,
+                          theta=0.0).fit(x)
+    bh = BarnesHutTsne(max_iter=300, perplexity=20, seed=3, theta=0.5,
+                       bh_threshold=1).fit(x)
+    # KL of the sparse objective tracks the exact one within ~15%
+    assert bh.kl_history[-1] < exact.kl_history[-1] * 1.15 + 0.05
+
+    def separation(emb):
+        cs = np.stack([emb[labels == c].mean(0) for c in range(3)])
+        within = np.mean([np.linalg.norm(emb[labels == c] - cs[c], axis=1).mean()
+                          for c in range(3)])
+        between = np.mean([np.linalg.norm(cs[a] - cs[b])
+                           for a in range(3) for b in range(a + 1, 3)])
+        return between / within
+    assert separation(bh.get_data()) > 2.0
+    assert separation(bh.get_data()) > 0.4 * separation(exact.get_data())
+    assert bh.get_data().shape == (450, 2)
+
+
+def test_node2vec_embeds_communities():
+    """p/q-biased walks (reference Node2Vec.java:34): same community =>
+    closer embeddings; p=q=1 reduces to DeepWalk's uniform transitions."""
+    from deeplearning4j_tpu.graphs import Graph, Node2Vec
+    from deeplearning4j_tpu.graphs.node2vec import Node2VecWalkIterator
+
+    k = 6
+    g = Graph(2 * k)
+    for i in range(k):
+        for j in range(i + 1, k):
+            g.add_edge(i, j)
+            g.add_edge(k + i, k + j)
+    g.add_edge(0, k)  # one weak bridge between communities
+    n2v = Node2Vec(p=0.5, q=2.0, vector_size=16, window_size=4,
+                   walk_length=20, walks_per_vertex=8, epochs=20,
+                   learning_rate=0.3, seed=3)
+    n2v.fit(g)
+    intra = np.mean([n2v.similarity(1, j) for j in range(2, 6)])
+    inter = np.mean([n2v.similarity(1, j) for j in range(k + 1, 2 * k)])
+    assert intra > inter
+    # low q (DFS-like) vs high q (BFS-like) produce different transition stats
+    it_dfs = Node2VecWalkIterator(g, 12, p=1.0, q=0.25, seed=5)
+    it_bfs = Node2VecWalkIterator(g, 12, p=1.0, q=4.0, seed=5)
+
+    def mean_unique(walks):
+        return np.mean([len(set(w)) for w in walks])
+    # DFS-like walks roam further: more unique vertices per walk
+    assert mean_unique(it_dfs.walks()) > mean_unique(it_bfs.walks())
+
+
+def test_cnn_sentence_iterator_trains_text_cnn():
+    """Sentence tensors bridge the NLP stack to the CNN stack (reference
+    CnnSentenceDataSetIterator.java:47): padded (b, T, D, 1) batches train a
+    text-CNN end to end."""
+    from deeplearning4j_tpu.nlp import (
+        CnnSentenceDataSetIterator, CollectionLabeledSentenceProvider, Word2Vec,
+    )
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, InputType
+    from deeplearning4j_tpu.nn.conf.convolutional import ConvolutionLayer
+    from deeplearning4j_tpu.nn.conf.pooling import GlobalPoolingLayer
+    from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize.updaters import Adam
+
+    rng = np.random.default_rng(0)
+    good = ["great", "fine", "nice", "happy", "super"]
+    bad = ["awful", "poor", "sad", "bleak", "gross"]
+    fill = ["the", "a", "it", "was", "very"]
+    sents, labs = [], []
+    for _ in range(120):
+        pos = rng.random() < 0.5
+        words = list(rng.choice(fill, 3)) + \
+            list(rng.choice(good if pos else bad, 2))
+        rng.shuffle(words)
+        sents.append(" ".join(words))
+        labs.append("pos" if pos else "neg")
+    w2v = Word2Vec(layer_size=12, window_size=3, negative=3, epochs=8,
+                   batch_size=256, min_word_frequency=1, seed=1)
+    w2v.fit(sents)
+
+    provider = CollectionLabeledSentenceProvider(sents, labs, seed=2)
+    it = CnnSentenceDataSetIterator(provider, w2v, batch_size=40,
+                                    max_sentence_length=8)
+    ds = it.next()
+    assert ds.features.shape[1:] == (5, 12, 1)   # (T, vec, 1) NHWC
+    assert ds.features_mask.shape == ds.features.shape[:2]
+    assert ds.labels.shape[1] == 2
+    single = it.load_single_sentence("great nice day")
+    assert single.shape[0] == 1 and single.shape[2] == 12
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(4).updater(Adam(2e-2)).weight_init("relu").list()
+            .layer(ConvolutionLayer(n_out=8, kernel_size=(2, 12),
+                                    activation="relu"))
+            .layer(GlobalPoolingLayer(pooling_type="max"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(5, 12, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    batches = list(it)
+    s0 = net.score_dataset(batches[0])
+    for _ in range(60):
+        for ds in batches:
+            net.fit(ds)
+    s1 = net.score_dataset(batches[0])
+    assert s1 < s0 * 0.4, (s0, s1)
