@@ -128,6 +128,8 @@ class CnnSentenceDataSetIterator:
             s, l = self.provider.next_sentence()
             sents.append(self._vectors_for(s))
             labs.append(self._lab_idx[l])
+        if not sents:
+            raise StopIteration("sentence provider exhausted; reset() first")
         b = len(sents)
         T = max(v.shape[0] for v in sents)
         feats = np.zeros((b, T, self.vec_size, 1), np.float32)

@@ -67,6 +67,7 @@ class ComputationGraph:
         self._score = None
         self._rng = None
         self._rnn_carries = None
+        self._last_features = None  # last fit minibatch (listener sampling)
         self._jit_cache = {}
 
     # ------------------------------------------------------------------ init
@@ -429,6 +430,8 @@ class ComputationGraph:
             self.params, self.state, self.opt_state, k, inputs, labels, fmasks, lmasks)
         self._score = loss
         self.last_batch_size = int(inputs[0].shape[0])
+        # first sample per input only (see multilayer.py note)
+        self._last_features = [f[:1] for f in inputs]
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration, self.epoch)
         self.iteration += 1

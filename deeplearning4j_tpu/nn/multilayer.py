@@ -470,7 +470,9 @@ class MultiLayerNetwork:
             self.params, self.state, self.opt_state, k, x, y, fm, lm)
         self._score = loss
         self.last_batch_size = int(x.shape[0])
-        self._last_features = x  # for listeners that sample activations
+        # first sample only: listeners sample activations, and pinning
+        # the whole batch keeps large device buffers alive after fit()
+        self._last_features = x[:1]
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration, self.epoch)
         self.iteration += 1
@@ -497,7 +499,7 @@ class MultiLayerNetwork:
                 self.params, self.state, self.opt_state, carries, k, xs, ys, fs, ls)
             self._score = loss
             self.last_batch_size = int(x.shape[0])
-            self._last_features = xs
+            self._last_features = xs[:1]
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration, self.epoch)
             self.iteration += 1

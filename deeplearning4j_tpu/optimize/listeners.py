@@ -290,3 +290,94 @@ class CheckpointListener(TrainingListener):
         if path is None:
             raise FileNotFoundError(f"No checkpoints in {checkpoint_dir}")
         return restore(path)
+
+
+class ConvolutionalIterationListener(TrainingListener):
+    """Capture convolutional activation grids for the UI's /activations
+    module (reference ConvolutionIterationListener.java feeding
+    ConvolutionalListenerModule.java:32).
+
+    Every ``frequency`` iterations, runs the first sample of the last fit
+    minibatch forward, tiles each conv layer's channels into one grayscale
+    grid, and stores it as a base64 PNG update record (type id
+    ``ActivationsListener``) in ``storage``."""
+
+    def __init__(self, storage, frequency: int = 10,
+                 session_id: Optional[str] = None, max_layers: int = 4,
+                 max_channels: int = 64):
+        import socket as _socket
+        import uuid as _uuid
+        self.storage = storage
+        self.frequency = max(1, int(frequency))
+        self.session_id = session_id or str(_uuid.uuid4())
+        self.worker_id = _socket.gethostname()
+        self.max_layers = max_layers
+        self.max_channels = max_channels
+
+    @staticmethod
+    def _tile_png(act) -> str:
+        """(H, W, C) activation -> tiled grayscale grid PNG (base64)."""
+        import base64
+        import io
+
+        import numpy as np
+        from PIL import Image
+        a = np.asarray(act, np.float32)
+        h, w, c = a.shape
+        cols = int(np.ceil(np.sqrt(c)))
+        rows = int(np.ceil(c / cols))
+        grid = np.zeros((rows * (h + 1), cols * (w + 1)), np.float32)
+        for i in range(c):
+            ch = a[:, :, i]
+            lo, hi = float(ch.min()), float(ch.max())
+            ch = (ch - lo) / (hi - lo) if hi > lo else np.zeros_like(ch)
+            r, col = divmod(i, cols)
+            grid[r * (h + 1):r * (h + 1) + h,
+                 col * (w + 1):col * (w + 1) + w] = ch
+        img = Image.fromarray((grid * 255).astype(np.uint8), mode="L")
+        buf = io.BytesIO()
+        img.save(buf, format="PNG")
+        return base64.b64encode(buf.getvalue()).decode()
+
+    def _conv_activations(self, model):
+        """name -> (H, W, C) activation of each conv-ish layer for ONE
+        sample of the last minibatch."""
+        import numpy as np
+        x = getattr(model, "_last_features", None)
+        if x is None:
+            return {}
+        out = {}
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        if isinstance(model, MultiLayerNetwork):
+            acts = model.feed_forward(np.asarray(x)[:1])  # one act per layer
+            for i, (layer, a) in enumerate(zip(model.layers, acts)):
+                a = np.asarray(a)
+                if a.ndim == 4:
+                    out[f"layer{i}_{type(layer).__name__}"] = a[0]
+        else:  # ComputationGraph: acts of every vertex for input sample
+            import jax.numpy as jnp
+            feats = [jnp.asarray(np.asarray(f)[:1]) for f in x] \
+                if isinstance(x, (list, tuple)) else [jnp.asarray(np.asarray(x)[:1])]
+            acts, _, _, _ = model._forward(model.params, model.state, feats,
+                                           False, None, None)
+            for name in model.order:
+                a = np.asarray(acts[name])
+                if a.ndim == 4:
+                    out[name] = a[0]
+        return dict(list(out.items())[: self.max_layers])
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.frequency != 0:
+            return
+        layers = {}
+        for name, a in self._conv_activations(model).items():
+            layers[name] = self._tile_png(a[:, :, : self.max_channels])
+        if not layers:
+            return
+        from deeplearning4j_tpu.ui.server import ACTIVATIONS_TYPE_ID
+        self.storage.put_update({
+            "kind": "update", "session_id": self.session_id,
+            "type_id": ACTIVATIONS_TYPE_ID, "worker_id": self.worker_id,
+            "timestamp": int(time.time() * 1000),
+            "iteration": int(iteration), "layers": layers,
+        })

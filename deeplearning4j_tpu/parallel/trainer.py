@@ -259,13 +259,24 @@ class ClusterTrainer(ParallelWrapper):
                                    num_processes=num_processes,
                                    process_id=process_id)
 
-    def fit_local_shard(self, data, num_epochs: int = 1):
+    def fit_local_shard(self, data, num_epochs: int = 1,
+                        collective_timeout_s: Optional[float] = None,
+                        watchdog_every: int = 10):
         """Feed per-host local batches; assembles the global sharded array
-        from process-local data (multi-host path of ICI+DCN training)."""
+        from process-local data (multi-host path of ICI+DCN training).
+
+        ``collective_timeout_s`` arms a CollectiveWatchdog (SURVEY §5): every
+        ``watchdog_every`` batches the host syncs the dispatched step under a
+        deadline, so a hung DCN collective (dead peer / partition) raises a
+        diagnostic CollectiveTimeoutError instead of blocking forever."""
+        wd = None
+        if collective_timeout_s is not None:
+            from deeplearning4j_tpu.parallel.watchdog import CollectiveWatchdog
+            wd = CollectiveWatchdog(timeout_s=collective_timeout_s)
         self._place_params()
         if isinstance(data, DataSet):
             data = [data]
-        sharding = None
+        step_no = 0
         with self.mesh:
             for _ in range(num_epochs):
                 for ds in data:
@@ -280,6 +291,15 @@ class ClusterTrainer(ParallelWrapper):
                     self.model.fit(DataSet(gput(ds.features), gput(ds.labels),
                                            gput(ds.features_mask),
                                            gput(ds.labels_mask)))
+                    step_no += 1
+                    if wd is not None and step_no % max(1, watchdog_every) == 0:
+                        wd.sync(self.model.params,
+                                what=f"cluster step {step_no}")
+            if wd is not None:
+                # tail steps after the last every-N sync must not escape the
+                # deadline — a hang there would otherwise surface only at
+                # the caller's next (unguarded) host sync
+                wd.sync(self.model.params, what=f"epoch end (step {step_no})")
         return self
 
 

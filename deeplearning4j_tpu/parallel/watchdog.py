@@ -1,0 +1,143 @@
+"""Collective-timeout watchdog for multi-host training.
+
+SURVEY §5 / reference guardrail analog: ``ParallelWrapper.java:105-110``
+(worker-thread supervision). On a TPU pod, the failure mode is different: a
+peer process dying or a DCN partition leaves a collective (psum/all_gather)
+with no matching participant, and the local ``block_until_ready`` blocks
+FOREVER with no error. This watchdog bounds that wait: the blocking sync
+runs on a worker thread with a deadline; on expiry it emits a diagnostic
+(process index/count, device set, elapsed, what was being waited on) and
+raises ``CollectiveTimeoutError`` — or hard-aborts the process when
+``abort=True`` so the job scheduler can reschedule the worker (a hung XLA
+execution cannot be cancelled from Python; only process death frees the
+chip).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+log = logging.getLogger(__name__)
+
+
+class CollectiveTimeoutError(RuntimeError):
+    pass
+
+
+class CollectiveWatchdog:
+    """Deadline guard around host-side syncs of device work.
+
+    Usage::
+
+        wd = CollectiveWatchdog(timeout_s=120)
+        ...dispatch jitted multi-host step...
+        wd.sync(params, what="train step 42")   # bounded wait
+
+    or as a context manager around any blocking call::
+
+        with wd.guard("eval all_gather"):
+            value = float(loss)
+    """
+
+    def __init__(self, timeout_s: float = 300.0, abort: bool = False,
+                 on_timeout: Optional[Callable[[str], None]] = None):
+        self.timeout_s = float(timeout_s)
+        self.abort = abort
+        self.on_timeout = on_timeout
+
+    # ------------------------------------------------------------ diagnostics
+    def _diagnose(self, what: str, elapsed: float) -> str:
+        import jax
+        try:
+            pidx, pcnt = jax.process_index(), jax.process_count()
+            devs = ",".join(str(d) for d in jax.local_devices())
+        except Exception:
+            pidx = pcnt = -1
+            devs = "?"
+        return (f"collective watchdog: '{what}' did not complete within "
+                f"{self.timeout_s:.0f}s (elapsed {elapsed:.1f}s) — likely a "
+                f"hung DCN/ICI collective (dead peer or partition). "
+                f"process {pidx}/{pcnt}, local devices [{devs}]")
+
+    def _expire(self, what: str, elapsed: float):
+        msg = self._diagnose(what, elapsed)
+        log.error(msg)
+        if self.on_timeout is not None:
+            try:
+                self.on_timeout(msg)
+            except Exception:
+                log.exception("watchdog on_timeout callback failed")
+        if self.abort:
+            # a hung XLA execution cannot be cancelled from Python; process
+            # death is the only way to free the chip for a restart
+            log.error("watchdog aborting process (abort=True)")
+            os._exit(42)
+        raise CollectiveTimeoutError(msg)
+
+    # ------------------------------------------------------------------ sync
+    def sync(self, tree, what: str = "device sync"):
+        """Bounded ``jax.block_until_ready`` over a pytree. Returns the tree
+        on success; raises CollectiveTimeoutError (or aborts) on deadline."""
+        import jax
+        done = threading.Event()
+        err: list = []
+
+        def wait():
+            try:
+                jax.block_until_ready(tree)
+            except Exception as e:  # surfaced on the caller thread
+                err.append(e)
+            finally:
+                done.set()
+
+        t0 = time.monotonic()
+        t = threading.Thread(target=wait, daemon=True)
+        t.start()
+        if not done.wait(self.timeout_s):
+            self._expire(what, time.monotonic() - t0)
+        if err:
+            raise err[0]
+        return tree
+
+    # --------------------------------------------------------------- guard()
+    class _Guard:
+        def __init__(self, wd: "CollectiveWatchdog", what: str):
+            self.wd = wd
+            self.what = what
+            self._timer: Optional[threading.Timer] = None
+            self._t0 = 0.0
+            self._fired = threading.Event()
+
+        def __enter__(self):
+            self._t0 = time.monotonic()
+
+            def fire():
+                self._fired.set()
+                # raising in the caller thread is impossible from a timer;
+                # log + optional abort here, caller sees the flag on exit
+                try:
+                    self.wd._expire(self.what, time.monotonic() - self._t0)
+                except CollectiveTimeoutError:
+                    pass
+            self._timer = threading.Timer(self.wd.timeout_s, fire)
+            self._timer.daemon = True
+            self._timer.start()
+            return self
+
+        def __exit__(self, exc_type, exc, tb):
+            if self._timer is not None:
+                self._timer.cancel()
+            if self._fired.is_set() and exc_type is None:
+                raise CollectiveTimeoutError(self.wd._diagnose(
+                    self.what, time.monotonic() - self._t0))
+            return False
+
+    def guard(self, what: str = "guarded section") -> "_Guard":
+        """Context manager: if the body outlives the deadline, diagnostics
+        fire immediately (and the process aborts when ``abort=True``);
+        otherwise exiting in time cancels the timer."""
+        return CollectiveWatchdog._Guard(self, what)

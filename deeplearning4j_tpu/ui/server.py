@@ -237,8 +237,136 @@ setInterval(() => { if (CUR) render(false); }, 3000);
 """
 
 
+_TSNE_HTML = r"""<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>t-SNE viewer</title>
+<style>
+body { margin: 0; font: 14px/1.45 system-ui, sans-serif; background: #fcfcfb;
+  color: #0b0b0b; }
+.wrap { padding: 20px 28px; }
+h1 { font-size: 18px; font-weight: 600; margin: 0 0 10px; }
+select { border: 1px solid #e3e2de; border-radius: 6px; padding: 4px 8px; }
+svg { background: #fff; border: 1px solid #e3e2de; border-radius: 10px; }
+circle { opacity: .75; }
+.lbl { font-size: 9px; fill: #52514e; }
+</style></head>
+<body><div class="wrap">
+<h1>t-SNE viewer</h1>
+<label>Session <select id="session"></select></label>
+<div id="plot" style="margin-top:14px"></div>
+<script>
+"use strict";
+const $ = id => document.getElementById(id);
+const PALETTE = ["#2a78d6","#eb6834","#2e9e62","#b04fd6","#d6a32a",
+                 "#d64f6e","#3ec6c0","#8a6d4f","#6277d8","#9aa53b"];
+// session names and labels arrive from unauthenticated POSTs: escape before
+// any innerHTML interpolation (stored-XSS guard)
+const esc = s => String(s).replaceAll("&", "&amp;").replaceAll("<", "&lt;")
+  .replaceAll(">", "&gt;").replaceAll('"', "&quot;").replaceAll("'", "&#39;");
+async function j(url) { const r = await fetch(url); return r.json(); }
+async function load() {
+  const sessions = await j("/api/tsne/sessions");
+  const sel = $("session");
+  sel.innerHTML = sessions.map(s => `<option>${esc(s)}</option>`).join("");
+  sel.onchange = () => render(sel.value);
+  if (sessions.length) render(sessions[sessions.length-1]);
+  else $("plot").textContent = "no t-SNE sessions uploaded";
+}
+async function render(name) {
+  const d = await j(`/api/tsne/data?session=${encodeURIComponent(name)}`);
+  const xs = d.coords.map(c => c[0]), ys = d.coords.map(c => c[1]);
+  const mnx = Math.min(...xs), mxx = Math.max(...xs);
+  const mny = Math.min(...ys), mxy = Math.max(...ys);
+  const S = 640, P = 24;
+  const sx = v => P + (v - mnx) / (mxx - mnx || 1) * (S - 2*P);
+  const sy = v => S - P - (v - mny) / (mxy - mny || 1) * (S - 2*P);
+  const cats = [...new Set(d.labels || [])];
+  let svg = `<svg viewBox="0 0 ${S} ${S}" width="${S}" height="${S}">`;
+  d.coords.forEach((c, i) => {
+    const col = d.labels ? PALETTE[cats.indexOf(d.labels[i]) % PALETTE.length]
+                         : PALETTE[0];
+    svg += `<circle cx="${sx(c[0]).toFixed(1)}" cy="${sy(c[1]).toFixed(1)}" r="2.5" fill="${col}"><title>${d.labels ? esc(d.labels[i]) : i}</title></circle>`;
+  });
+  cats.forEach((c, k) => {
+    svg += `<circle cx="${S-86}" cy="${18+k*14}" r="4" fill="${PALETTE[k % PALETTE.length]}"/>`;
+    svg += `<text class="lbl" x="${S-76}" y="${21+k*14}">${esc(c)}</text>`;
+  });
+  svg += `</svg>`;
+  $("plot").innerHTML = svg;
+}
+load();
+</script></div></body></html>
+"""
+
+_ACTIVATIONS_HTML = r"""<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>Convolutional activations</title>
+<style>
+body { margin: 0; font: 14px/1.45 system-ui, sans-serif; background: #fcfcfb;
+  color: #0b0b0b; }
+.wrap { padding: 20px 28px; }
+h1 { font-size: 18px; font-weight: 600; margin: 0 0 10px; }
+h2 { font-size: 13px; color: #52514e; margin: 16px 0 6px; }
+img { image-rendering: pixelated; border: 1px solid #e3e2de;
+  border-radius: 6px; max-width: 480px; }
+select { border: 1px solid #e3e2de; border-radius: 6px; padding: 4px 8px; }
+.meta { color: #52514e; font-size: 12px; }
+</style></head>
+<body><div class="wrap">
+<h1>Convolutional activations</h1>
+<label>Session <select id="session"></select></label>
+<span class="meta" id="meta"></span>
+<div id="grids"></div>
+<script>
+"use strict";
+const $ = id => document.getElementById(id);
+const esc = s => String(s).replaceAll("&", "&amp;").replaceAll("<", "&lt;")
+  .replaceAll(">", "&gt;").replaceAll('"', "&quot;").replaceAll("'", "&#39;");
+async function j(url) { const r = await fetch(url); return r.json(); }
+async function load() {
+  const sessions = await j("/api/activations/sessions");
+  const sel = $("session");
+  sel.innerHTML = sessions.map(s => `<option>${esc(s)}</option>`).join("");
+  sel.onchange = () => render(sel.value);
+  if (sessions.length) render(sessions[sessions.length-1]);
+  else $("grids").textContent = "no activation records";
+}
+async function render(name) {
+  const recs = await j(`/api/activations/data?session=${encodeURIComponent(name)}`);
+  const last = recs[recs.length-1];
+  if (!last) { $("grids").textContent = "no activation records"; return; }
+  $("meta").textContent = `iteration ${last.iteration}`;
+  $("grids").innerHTML = Object.entries(last.layers).map(([layer, png]) =>
+    `<h2>${esc(layer)}</h2><img src="data:image/png;base64,${esc(png)}" alt="${esc(layer)}"/>`
+  ).join("");
+}
+load();
+setInterval(() => { const s = $("session").value; if (s) render(s); }, 4000);
+</script></div></body></html>
+"""
+
+# type id for convolutional-activation update records (reference
+# ConvolutionalListenerModule.java:32 consumes ConvolutionIterationListener)
+ACTIVATIONS_TYPE_ID = "ActivationsListener"
+
+
+def _sanitize_tsne(coords, labels=None) -> dict:
+    """Coerce to a rectangular float (n, 2) list + stringified labels; the
+    viewer reads c[0]/c[1] of every row, so ragged/non-numeric input must be
+    rejected at upload time, whichever path it arrives by."""
+    import numpy as np
+    c = np.asarray(coords, float)
+    if c.ndim != 2 or c.shape[1] < 2:
+        raise ValueError("coords must be (n, >=2)")
+    out_labels = None
+    if labels is not None:
+        if len(labels) != c.shape[0]:
+            raise ValueError("labels must align with coords")
+        out_labels = [str(l) for l in labels]
+    return {"coords": c[:, :2].tolist(), "labels": out_labels}
+
+
 class _Handler(BaseHTTPRequestHandler):
     storage = None  # set by UIServer
+    tsne_sessions = None  # dict name -> {"coords": [[x,y]...], "labels": [...]}
 
     def log_message(self, fmt, *args):  # quiet
         pass
@@ -263,12 +391,37 @@ class _Handler(BaseHTTPRequestHandler):
             getattr(st, "refresh", lambda: 0)()
         if url.path in ("/", "/train", "/train/overview"):
             self._send(200, _DASHBOARD_HTML.encode(), "text/html; charset=utf-8")
+        elif url.path == "/tsne":
+            # reference TsneModule.java:26 /tsne route
+            self._send(200, _TSNE_HTML.encode(), "text/html; charset=utf-8")
+        elif url.path == "/activations":
+            # reference ConvolutionalListenerModule.java:32 /activations
+            self._send(200, _ACTIVATIONS_HTML.encode(),
+                       "text/html; charset=utf-8")
         elif url.path == "/api/sessions":
             self._json(st.list_session_ids() if st else [])
         elif url.path == "/api/static":
             self._json(st.get_static_info(session, TYPE_ID) if st else None)
         elif url.path == "/api/updates":
             self._json(st.get_all_updates(session, TYPE_ID) if st else [])
+        elif url.path == "/api/tsne/sessions":
+            ts = type(self).tsne_sessions or {}
+            self._json(sorted(ts.keys()))
+        elif url.path == "/api/tsne/data":
+            ts = type(self).tsne_sessions or {}
+            if session in ts:
+                self._json(ts[session])
+            else:
+                self._send(404, b"unknown t-SNE session", "text/plain")
+        elif url.path == "/api/activations/sessions":
+            if st is None:
+                self._json([])
+            else:
+                self._json([s for s in st.list_session_ids()
+                            if ACTIVATIONS_TYPE_ID in st.list_type_ids(s)])
+        elif url.path == "/api/activations/data":
+            self._json(st.get_all_updates(session, ACTIVATIONS_TYPE_ID)
+                       if st else [])
         else:
             self._send(404, b"not found", "text/plain")
 
@@ -277,6 +430,21 @@ class _Handler(BaseHTTPRequestHandler):
         route; fed by storage.remote.RemoteUIStatsStorageRouter)."""
         url = urlparse(self.path)
         st = type(self).storage
+        if url.path == "/api/tsne/upload":
+            # reference TsneModule POST /tsne/upload: store named coord sets
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length))
+                name = str(body["session"])
+                entry = _sanitize_tsne(body["coords"], body.get("labels"))
+                ts = type(self).tsne_sessions
+                if ts is None:
+                    ts = type(self).tsne_sessions = {}
+                ts[name] = entry
+                self._json({"ok": True, "n": len(entry["coords"])})
+            except Exception as e:
+                self._send(400, f"bad upload: {e}".encode(), "text/plain")
+            return
         if url.path not in ("/remoteReceive", "/remoteReceive/") or st is None:
             self._send(404, b"not found", "text/plain")
             return
@@ -307,6 +475,7 @@ class UIServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.storage = None
+        self._tsne_sessions: dict = {}
 
     @classmethod
     def get_instance(cls, port: int = 9000,
@@ -326,7 +495,9 @@ class UIServer:
 
     def attach(self, storage):
         self.storage = storage
-        handler = type("BoundHandler", (_Handler,), {"storage": storage})
+        handler = type("BoundHandler", (_Handler,),
+                       {"storage": storage,
+                        "tsne_sessions": self._tsne_sessions})
         if self._httpd is None:
             self._httpd = ThreadingHTTPServer((self.bind_address, self.port),
                                               handler)
@@ -336,6 +507,12 @@ class UIServer:
             self._thread.start()
         else:
             self._httpd.RequestHandlerClass = handler
+        return self
+
+    def upload_tsne(self, session: str, coords, labels=None):
+        """In-process equivalent of POST /api/tsne/upload (reference
+        UIServer-side of TsneModule): accepts a (n, 2+) array-like."""
+        self._tsne_sessions[session] = _sanitize_tsne(coords, labels)
         return self
 
     def detach(self):

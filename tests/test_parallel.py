@@ -208,3 +208,37 @@ def test_flash_self_attention_fallback_matches_reference(devices):
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-2 if jax.default_backend() == "tpu"
                                    else 1e-6)
+
+
+def test_collective_watchdog():
+    """Watchdog (SURVEY §5): fast syncs pass through; an over-deadline wait
+    raises a diagnostic CollectiveTimeoutError instead of hanging."""
+    import time as _time
+
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.parallel.watchdog import (
+        CollectiveTimeoutError, CollectiveWatchdog,
+    )
+
+    wd = CollectiveWatchdog(timeout_s=30.0)
+    x = jnp.arange(8.0) * 2
+    assert wd.sync(x, what="small add") is x  # completes well in deadline
+
+    msgs = []
+    wd2 = CollectiveWatchdog(timeout_s=0.2, on_timeout=msgs.append)
+    with pytest.raises(CollectiveTimeoutError) as ei:
+        with wd2.guard("deliberately slow host section"):
+            _time.sleep(0.6)
+    assert "did not complete" in str(ei.value)
+    assert msgs and "deliberately slow" in msgs[0]
+
+
+def test_cluster_trainer_watchdog_smoke():
+    """fit_local_shard with an armed watchdog trains normally when healthy."""
+    net = _net(seed=44)
+    trainer = ClusterTrainer(net)
+    ds = _iris_batch(48)
+    trainer.fit_local_shard(ds, num_epochs=2, collective_timeout_s=60.0,
+                            watchdog_every=1)
+    assert net.score() is not None

@@ -161,3 +161,147 @@ def test_dashboard_html_self_contained():
     assert "http://" not in html.replace("http://localhost", "")
     assert "https://" not in html
     assert "<script src" not in html and "link rel" not in html
+
+
+# ---------------------------------------------------------------------------
+# t-SNE viewer + conv-activations modules (reference TsneModule.java:26,
+# ConvolutionalListenerModule.java:32)
+
+def test_tsne_viewer_module():
+    server = UIServer(port=0).attach(InMemoryStatsStorage())
+    try:
+        base = f"http://localhost:{server.port}"
+        # in-process upload
+        server.upload_tsne("run-a", [[0.0, 1.0], [2.0, 3.0]], labels=["x", "y"])
+        # HTTP upload (reference TsneModule POST /tsne/upload)
+        body = json.dumps({"session": "run-b",
+                           "coords": [[1, 2], [3, 4], [5, 6]]}).encode()
+        req = urllib.request.Request(f"{base}/api/tsne/upload", data=body)
+        assert json.loads(urllib.request.urlopen(req).read())["n"] == 3
+        sessions = json.loads(urllib.request.urlopen(
+            f"{base}/api/tsne/sessions").read())
+        assert sessions == ["run-a", "run-b"]
+        d = json.loads(urllib.request.urlopen(
+            f"{base}/api/tsne/data?session=run-a").read())
+        assert d["coords"] == [[0.0, 1.0], [2.0, 3.0]]
+        assert d["labels"] == ["x", "y"]
+        page = urllib.request.urlopen(f"{base}/tsne").read().decode()
+        assert "t-SNE viewer" in page and "/api/tsne/sessions" in page
+    finally:
+        server.stop()
+
+
+def test_conv_activations_module():
+    import base64
+
+    from deeplearning4j_tpu.nn.conf.convolutional import ConvolutionLayer
+    from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+    from deeplearning4j_tpu.optimize.listeners import (
+        ConvolutionalIterationListener,
+    )
+    from deeplearning4j_tpu.optimize.updaters import Adam
+
+    storage = InMemoryStatsStorage()
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(1e-2)).weight_init("relu").list()
+            .layer(ConvolutionLayer(n_out=6, kernel_size=(3, 3),
+                                    convolution_mode="same",
+                                    activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    lis = ConvolutionalIterationListener(storage, frequency=1,
+                                         session_id="conv-sess")
+    net.set_listeners(lis)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 8, 8, 1)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)]
+    net.fit(DataSet(x, y), num_epochs=2)
+
+    recs = storage.get_all_updates("conv-sess", "ActivationsListener")
+    assert len(recs) == 2
+    layers = recs[-1]["layers"]
+    assert any("ConvolutionLayer" in k for k in layers)
+    png = base64.b64decode(next(iter(layers.values())))
+    assert png[:8] == b"\x89PNG\r\n\x1a\n"  # valid PNG magic
+
+    server = UIServer(port=0).attach(storage)
+    try:
+        base = f"http://localhost:{server.port}"
+        sess = json.loads(urllib.request.urlopen(
+            f"{base}/api/activations/sessions").read())
+        assert sess == ["conv-sess"]
+        data = json.loads(urllib.request.urlopen(
+            f"{base}/api/activations/data?session=conv-sess").read())
+        assert data[-1]["iteration"] == recs[-1]["iteration"]
+        page = urllib.request.urlopen(f"{base}/activations").read().decode()
+        assert "Convolutional activations" in page
+    finally:
+        server.stop()
+
+
+def test_inline_js_structural_contract():
+    """No JS engine ships in this image, so validate the inline dashboard
+    JS structurally: balanced brackets/template-literals outside string
+    context, every getElementById target present in the HTML, and every
+    fetched /api route actually served (catches renamed ids, route drift,
+    and bracket/quote breakage from edits)."""
+    import re
+
+    from deeplearning4j_tpu.ui import server as ui_server
+
+    pages = {"dashboard": dashboard_html(),
+             "tsne": ui_server._TSNE_HTML,
+             "activations": ui_server._ACTIVATIONS_HTML}
+    served = ["/api/sessions", "/api/static", "/api/updates",
+              "/api/tsne/sessions", "/api/tsne/data", "/api/tsne/upload",
+              "/api/activations/sessions", "/api/activations/data",
+              "/remoteReceive"]
+    for name, html in pages.items():
+        scripts = re.findall(r"<script>(.*?)</script>", html, re.S)
+        assert scripts, name
+        js = "\n".join(scripts)
+        # bracket balance with a tiny string/template scanner
+        stack = []
+        mode = None  # None | "'" | '"' | "`"
+        i = 0
+        while i < len(js):
+            ch = js[i]
+            if mode:
+                if ch == "\\":
+                    i += 2
+                    continue
+                if ch == mode:
+                    mode = None
+                elif mode == "`" and ch == "$" and js[i:i+2] == "${":
+                    stack.append("${")
+                    mode = None  # back to expression context inside ${...}
+                    i += 1
+            else:
+                if ch in "'\"`":
+                    mode = ch
+                elif ch in "([{":
+                    stack.append(ch)
+                elif ch in ")]}":
+                    if ch == "}" and stack and stack[-1] == "${":
+                        stack.pop()
+                        mode = "`"
+                    else:
+                        opener = {")": "(", "]": "[", "}": "{"}[ch]
+                        assert stack and stack[-1] == opener, \
+                            f"{name}: unbalanced '{ch}' at {i}"
+                        stack.pop()
+            i += 1
+        assert not stack, f"{name}: unclosed {stack}"
+        assert mode is None, f"{name}: unterminated {mode} string"
+        # DOM-id contract
+        for el_id in set(re.findall(r"\$\(\"([a-zA-Z_]+)\"\)", js)) | \
+                set(re.findall(r"getElementById\(\"([a-zA-Z_]+)\"\)", js)):
+            assert f'id="{el_id}"' in html or f"id=\"{el_id}\"" in html or \
+                js.count(f'id="{el_id}"'), \
+                f"{name}: JS references missing DOM id '{el_id}'"
+        # route contract
+        for route in set(re.findall(r"""fetch\([`"'](/api/[a-z/]+)""", js)) | \
+                set(re.findall(r"""j\([`"'](/api/[a-z/]+)""", js)):
+            assert route in served, f"{name}: JS fetches unserved {route}"
