@@ -29,13 +29,15 @@ import numpy as np
 from deeplearning4j_tpu.nn.conf.convolutional import (
     Convolution1DLayer, ConvolutionLayer, Cropping1D, Cropping2D,
     SeparableConvolution2D, Subsampling1DLayer, SubsamplingLayer,
-    Upsampling2D, ZeroPadding1DLayer, ZeroPaddingLayer,
+    Upsampling1D, Upsampling2D, ZeroPadding1DLayer, ZeroPaddingLayer,
 )
 from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex, MergeVertex
 from deeplearning4j_tpu.nn.conf.layers import (
     ActivationLayer, DenseLayer, DropoutLayer, PReLULayer,
 )
-from deeplearning4j_tpu.nn.conf.normalization import BatchNormalization
+from deeplearning4j_tpu.nn.conf.normalization import (
+    BatchNormalization, LocalResponseNormalization,
+)
 from deeplearning4j_tpu.nn.conf.pooling import GlobalPoolingLayer
 from deeplearning4j_tpu.nn.conf.recurrent import (
     EmbeddingSequenceLayer, GRU, LSTM, LastTimeStep, SimpleRnn,
@@ -111,6 +113,11 @@ def map_loss(name: str) -> str:
     if key not in _LOSS_MAP:
         raise KerasImportError(f"Unsupported Keras loss '{name}'")
     return _LOSS_MAP[key]
+
+
+def _scalar(v) -> int:
+    """Keras configs store 1-D sizes as either ints or length-1 lists."""
+    return int(v[0]) if isinstance(v, (list, tuple)) else int(v)
 
 
 def _pair(v):
@@ -233,6 +240,7 @@ def _check_data_format(cfg, ctx):
 
 @register_keras_layer("Conv2D")
 @register_keras_layer("Convolution2D")
+@register_keras_layer("AtrousConvolution2D")
 def _conv2d(cfg, ctx):
     _check_data_format(cfg, ctx)
     use_bias = cfg.get("use_bias", True)
@@ -244,7 +252,7 @@ def _conv2d(cfg, ctx):
                                   (cfg.get("nb_row", 3), cfg.get("nb_col", 3)))),
         stride=_pair(cfg.get("strides", cfg.get("subsample", (1, 1)))),
         convolution_mode="same" if padding == "same" else "truncate",
-        dilation=_pair(cfg.get("dilation_rate", (1, 1))),
+        dilation=_pair(cfg.get("dilation_rate", cfg.get("atrous_rate", (1, 1)))),
         has_bias=use_bias,
         activation=map_activation(cfg.get("activation", "linear")),
     )
@@ -290,20 +298,23 @@ def _sepconv2d(cfg, ctx):
 
 @register_keras_layer("Conv1D")
 @register_keras_layer("Convolution1D")
+@register_keras_layer("AtrousConvolution1D")
 def _conv1d(cfg, ctx):
     use_bias = cfg.get("use_bias", True)
     k = cfg.get("kernel_size", cfg.get("filter_length", 3))
-    k = int(k[0]) if isinstance(k, (list, tuple)) else int(k)
+    k = _scalar(k)
     s = cfg.get("strides", cfg.get("subsample_length", 1))
-    s = int(s[0]) if isinstance(s, (list, tuple)) else int(s)
+    s = _scalar(s)
     padding = cfg.get("padding", cfg.get("border_mode", "valid"))
     if padding == "causal":
         raise KerasImportError("causal Conv1D padding is not supported")
+    d = _scalar(cfg.get("dilation_rate", cfg.get("atrous_rate", 1)))
     layer = Convolution1DLayer(
         name=cfg.get("name"),
         n_out=int(cfg.get("filters", cfg.get("nb_filter", 0))),
         kernel_size=k, stride=s,
         convolution_mode="same" if padding == "same" else "truncate",
+        dilation=d,
         has_bias=use_bias,
         activation=map_activation(cfg.get("activation", "linear")),
     )
@@ -341,10 +352,8 @@ def _avgpool2d(cfg, ctx):
 
 
 def _pool1d(cfg, ctx, mode):
-    pool = cfg.get("pool_size", 2)
-    pool = int(pool[0]) if isinstance(pool, (list, tuple)) else int(pool)
-    strides = cfg.get("strides") or pool
-    strides = int(strides[0]) if isinstance(strides, (list, tuple)) else int(strides)
+    pool = _scalar(cfg.get("pool_size", 2))
+    strides = _scalar(cfg.get("strides") or pool)
     return KerasLayerSpec(layer=Subsampling1DLayer(
         name=cfg.get("name"), kernel_size=pool, stride=strides,
         convolution_mode="same" if cfg.get("padding") == "same" else "truncate",
@@ -390,6 +399,32 @@ def _gavgpool1d(cfg, ctx):
 def _upsampling2d(cfg, ctx):
     return KerasLayerSpec(layer=Upsampling2D(
         name=cfg.get("name"), size=_pair(cfg.get("size", (2, 2)))))
+
+
+@register_keras_layer("UpSampling1D")
+def _upsampling1d(cfg, ctx):
+    return KerasLayerSpec(layer=Upsampling1D(
+        name=cfg.get("name"), size=_scalar(cfg.get("size", cfg.get("length", 2)))))
+
+
+@register_keras_layer("LRN")
+def _lrn(cfg, ctx):
+    """Caffe-style local response normalization shipped as a Keras custom
+    layer in GoogLeNet-era model files (reference keras/layers/custom/
+    KerasLRN.java — pre-registered, no user hook needed)."""
+    return KerasLayerSpec(layer=LocalResponseNormalization(
+        name=cfg.get("name"),
+        k=float(cfg.get("k", 2.0)), n=int(cfg.get("n", 5)),
+        alpha=float(cfg.get("alpha", 1e-4)),
+        beta=float(cfg.get("beta", 0.75))))
+
+
+@register_keras_layer("PoolHelper")
+def _pool_helper(cfg, ctx):
+    """Crops the first row/column (Caffe->Keras GoogLeNet pooling alignment
+    shim; reference keras/layers/custom/KerasPoolHelper.java)."""
+    return KerasLayerSpec(layer=Cropping2D(
+        name=cfg.get("name"), cropping=(1, 0, 1, 0)))
 
 
 @register_keras_layer("ZeroPadding2D")

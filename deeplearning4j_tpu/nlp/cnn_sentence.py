@@ -129,7 +129,9 @@ class CnnSentenceDataSetIterator:
             sents.append(self._vectors_for(s))
             labs.append(self._lab_idx[l])
         if not sents:
-            raise StopIteration("sentence provider exhausted; reset() first")
+            # NOT StopIteration: PEP 479 turns that into RuntimeError when
+            # this is called inside a generator frame
+            raise ValueError("sentence provider exhausted; reset() first")
         b = len(sents)
         T = max(v.shape[0] for v in sents)
         feats = np.zeros((b, T, self.vec_size, 1), np.float32)

@@ -332,6 +332,7 @@ class Convolution1DLayer(BaseLayer):
     stride: int = 1
     padding: int = 0
     convolution_mode: str = "truncate"
+    dilation: int = 1
     has_bias: bool = True
     activation: str = "identity"
 
@@ -345,7 +346,7 @@ class Convolution1DLayer(BaseLayer):
         t = it.timeseries_length
         if t is not None:
             t = _conv_out(t, self.kernel_size, self.stride, self.padding,
-                          self.convolution_mode)
+                          self.convolution_mode, self.dilation)
         return InputType.recurrent(self.n_out, t)
 
     def init(self, rng, it: InputType, dtype=jnp.float32):
@@ -364,6 +365,7 @@ class Convolution1DLayer(BaseLayer):
                else ((self.padding, self.padding),))
         z = lax.conv_general_dilated(
             x, params["W"], window_strides=(self.stride,), padding=pad,
+            rhs_dilation=(self.dilation,),
             dimension_numbers=("NWC", "WIO", "NWC"))
         if self.has_bias:
             z = z + params["b"]

@@ -307,6 +307,13 @@ class ConvolutionalIterationListener(TrainingListener):
                  max_channels: int = 64):
         import socket as _socket
         import uuid as _uuid
+        try:
+            import PIL  # noqa: F401
+            self._png_ok = True
+        except ImportError:
+            log.warning("Pillow not available: ConvolutionalIterationListener "
+                        "disabled (no PNG encoder)")
+            self._png_ok = False
         self.storage = storage
         self.frequency = max(1, int(frequency))
         self.session_id = session_id or str(_uuid.uuid4())
@@ -367,7 +374,7 @@ class ConvolutionalIterationListener(TrainingListener):
         return dict(list(out.items())[: self.max_layers])
 
     def iteration_done(self, model, iteration, epoch):
-        if iteration % self.frequency != 0:
+        if not self._png_ok or iteration % self.frequency != 0:
             return
         layers = {}
         for name, a in self._conv_activations(model).items():

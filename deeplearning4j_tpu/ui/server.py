@@ -356,6 +356,10 @@ def _sanitize_tsne(coords, labels=None) -> dict:
     c = np.asarray(coords, float)
     if c.ndim != 2 or c.shape[1] < 2:
         raise ValueError("coords must be (n, >=2)")
+    if not np.isfinite(c[:, :2]).all():
+        # bare NaN/Infinity tokens are invalid JSON: the viewer's
+        # response.json() would throw and silently never render
+        raise ValueError("coords must be finite")
     out_labels = None
     if labels is not None:
         if len(labels) != c.shape[0]:

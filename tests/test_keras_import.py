@@ -330,3 +330,74 @@ class TestKerasV3Format:
         net = import_keras_model(path)
         x = rng.standard_normal((3, 7, 4)).astype(np.float32)
         np.testing.assert_allclose(net.output(x), np.asarray(m(x)), atol=1e-5)
+
+
+class TestConverterTail:
+    """Round-4 converter tail (reference KerasAtrousConvolution1D/2D,
+    KerasUpsampling1D, keras/layers/custom/KerasLRN + KerasPoolHelper)."""
+
+    def test_dilated_conv2d_golden(self, tmp_path):
+        keras = pytest.importorskip("keras")
+        m = keras.Sequential([
+            keras.layers.Input((12, 12, 2)),
+            keras.layers.Conv2D(4, 3, dilation_rate=2, padding="same",
+                                activation="relu"),
+        ])
+        path = str(tmp_path / "dil2d.h5")
+        m.save(path)
+        net = import_keras_sequential_model_and_weights(path)
+        x = np.random.default_rng(0).standard_normal((3, 12, 12, 2)).astype(np.float32)
+        np.testing.assert_allclose(net.output(x), m.predict(x, verbose=0),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_dilated_conv1d_and_upsampling1d_golden(self, tmp_path):
+        keras = pytest.importorskip("keras")
+        m = keras.Sequential([
+            keras.layers.Input((16, 3)),
+            keras.layers.Conv1D(5, 3, dilation_rate=3, padding="same",
+                                activation="tanh"),
+            keras.layers.UpSampling1D(2),
+        ])
+        path = str(tmp_path / "dil1d.h5")
+        m.save(path)
+        net = import_keras_sequential_model_and_weights(path)
+        x = np.random.default_rng(1).standard_normal((2, 16, 3)).astype(np.float32)
+        np.testing.assert_allclose(net.output(x), m.predict(x, verbose=0),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_lrn_and_pool_helper_config_path(self):
+        """LRN/PoolHelper arrive as pre-registered custom layers in
+        GoogLeNet-era files; exercised via the converter registry."""
+        from deeplearning4j_tpu.modelimport.keras_layers import convert_layer
+        from deeplearning4j_tpu.nn.conf.convolutional import Cropping2D
+        from deeplearning4j_tpu.nn.conf.normalization import (
+            LocalResponseNormalization,
+        )
+
+        spec = convert_layer("LRN", {"name": "lrn1", "alpha": 1e-4,
+                                     "beta": 0.75, "k": 2, "n": 5}, {})
+        assert isinstance(spec.layer, LocalResponseNormalization)
+        assert spec.layer.n == 5
+
+        spec2 = convert_layer("PoolHelper", {"name": "ph"}, {})
+        assert isinstance(spec2.layer, Cropping2D)
+        # crops the first row and column (Caffe alignment shim)
+        import jax.numpy as jnp
+        x = jnp.arange(2 * 5 * 5 * 1, dtype=jnp.float32).reshape(2, 5, 5, 1)
+        out, _ = spec2.layer.apply({}, {}, x)
+        assert out.shape == (2, 4, 4, 1)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x)[:, 1:, 1:, :])
+
+    def test_atrous_alias_config_path(self):
+        """Keras-1 class names map onto the dilated conv converters."""
+        from deeplearning4j_tpu.modelimport.keras_layers import convert_layer
+        spec = convert_layer("AtrousConvolution2D",
+                             {"name": "a", "filters": 4, "kernel_size": [3, 3],
+                              "atrous_rate": [2, 2], "padding": "same",
+                              "use_bias": False, "activation": "linear"}, {})
+        assert spec.layer.dilation == (2, 2)
+        spec1 = convert_layer("AtrousConvolution1D",
+                              {"name": "b", "filters": 2, "kernel_size": 3,
+                               "atrous_rate": 2, "padding": "same",
+                               "use_bias": False, "activation": "linear"}, {})
+        assert spec1.layer.dilation == 2
