@@ -1,19 +1,22 @@
 """Parallel inference.
 
 Parity surface: reference parallelism/ParallelInference.java:32 (round-robin
-device-pinned replicas, :97-134) + BatchedInferenceObservable dynamic
-batching.
+device-pinned replicas, :97-134 observables/worker loop) +
+BatchedInferenceObservable / BasicInferenceObservable dynamic batching.
 
 TPU-native: one jit-compiled forward with the batch sharded over the mesh
-replaces per-device replicas; a simple request-batching queue provides the
-dynamic-batching behaviour of BatchedInferenceObservable.
+replaces per-device replicas. Dynamic batching keeps the reference's shape:
+requests enqueue as observables; a background worker coalesces up to
+``batch_limit`` requests (waiting at most ``queue_timeout_ms`` for
+stragglers) into ONE device dispatch and distributes the per-request slices.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-from typing import Optional
+import time
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -22,21 +25,65 @@ import numpy as np
 from deeplearning4j_tpu.parallel.mesh import data_sharding, make_mesh, replicated
 
 
+class InferenceObservable:
+    """Per-request future (reference BasicInferenceObservable /
+    BatchedInferenceObservable's per-caller view)."""
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._out = None
+        self._err: Optional[BaseException] = None
+
+    def _resolve(self, out):
+        self._out = out
+        self._done.set()
+
+    def _fail(self, err: BaseException):
+        self._err = err
+        self._done.set()
+
+    def is_done(self) -> bool:
+        return self._done.is_set()
+
+    def get(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._done.wait(timeout):
+            raise TimeoutError("inference result not ready")
+        if self._err is not None:
+            raise self._err
+        return self._out
+
+
 class ParallelInference:
+    """``output()`` for synchronous sharded calls; ``submit()`` /
+    ``output_batched()`` for the dynamic-batching path.
+
+    inference_mode: "batched" coalesces concurrent requests on a worker
+    thread (reference InferenceMode.BATCHED); "sequential" dispatches each
+    request on the caller's thread (InferenceMode.SEQUENTIAL)."""
+
     def __init__(self, model, mesh=None, batch_limit: int = 32,
-                 queue_timeout_ms: int = 5):
+                 queue_timeout_ms: int = 5, inference_mode: str = "batched"):
+        if inference_mode not in ("batched", "sequential"):
+            raise ValueError(f"unknown inference_mode '{inference_mode}'")
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.batch_limit = batch_limit
         self.queue_timeout_ms = queue_timeout_ms
+        self.inference_mode = inference_mode
         if model.params is None:
             model.init()
         repl = jax.tree_util.tree_map(lambda a: replicated(self.mesh), model.params)
         model.params = jax.device_put(model.params, repl)
         self._q: "queue.Queue" = queue.Queue()
         self._worker: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._worker_lock = threading.Lock()
+        self._stop = threading.Event()
+        # observability (exercised by the latency/throughput tests)
+        self.requests_served = 0
+        self.batches_dispatched = 0
+        self.batch_sizes: List[int] = []
 
+    # ------------------------------------------------------------ sync path
     def output(self, x) -> np.ndarray:
         """Synchronous sharded inference (reference ParallelInference.output)."""
         with self.mesh:
@@ -50,33 +97,106 @@ class ParallelInference:
             out = self.model.output(arr)
             return out[:out.shape[0] - pad] if pad else out
 
-    def output_batched(self, x) -> np.ndarray:
-        """Queue + dynamic batching entry point (reference
-        BatchedInferenceObservable): collects concurrent requests into one
-        device batch."""
-        done = threading.Event()
-        slot = {}
-        self._q.put((np.asarray(x), slot, done))
-        self._drain()
-        done.wait()
-        return slot["out"]
-
-    def _drain(self):
-        with self._lock:
-            items = []
+    # -------------------------------------------------------- batched path
+    def submit(self, x) -> InferenceObservable:
+        """Enqueue one request; returns its observable (reference
+        ParallelInference.java:97 observable provider)."""
+        obs = InferenceObservable()
+        if self.inference_mode == "sequential":
             try:
-                while len(items) < self.batch_limit:
-                    items.append(self._q.get_nowait())
+                obs._resolve(self.output(np.asarray(x)))
+            except BaseException as e:  # surfaced at .get()
+                obs._fail(e)
+            self.requests_served += 1
+            return obs
+        # enqueue + worker liveness under one lock: a concurrent shutdown()
+        # (same lock) can then never strand this request between the put and
+        # the worker start
+        with self._worker_lock:
+            self._q.put((np.asarray(x), obs))
+            self._ensure_worker_locked()
+        return obs
+
+    def output_batched(self, x) -> np.ndarray:
+        """Blocking convenience over submit() (reference
+        BatchedInferenceObservable callers)."""
+        return self.submit(x).get()
+
+    _SENTINEL = object()
+
+    def shutdown(self):
+        """Stop the worker after draining; pending observables either get
+        served by the final drain or failed, never left hanging."""
+        with self._worker_lock:
+            w = self._worker
+            if w is not None and w.is_alive():
+                self._stop.set()
+                self._q.put(ParallelInference._SENTINEL)
+                w.join(timeout=10)
+            self._worker = None
+            # fail anything the worker did not reach (its get() callers
+            # would otherwise block forever)
+            leftovers = []
+            try:
+                while True:
+                    leftovers.append(self._q.get_nowait())
             except queue.Empty:
                 pass
+            for item in leftovers:
+                if item is not ParallelInference._SENTINEL:
+                    item[1]._fail(RuntimeError(
+                        "ParallelInference shut down before request served"))
+
+    # ------------------------------------------------------------- worker
+    def _ensure_worker_locked(self):
+        """Caller holds _worker_lock."""
+        if self._worker is None or not self._worker.is_alive():
+            self._stop.clear()
+            self._worker = threading.Thread(target=self._worker_loop,
+                                            daemon=True)
+            self._worker.start()
+
+    def _collect(self):
+        """Take up to batch_limit requests, waiting queue_timeout_ms for
+        stragglers after the first arrives (the reference's batching
+        window)."""
+        try:
+            first = self._q.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        if first is ParallelInference._SENTINEL:
+            return []
+        items = [first]
+        deadline = time.monotonic() + self.queue_timeout_ms / 1000.0
+        while len(items) < self.batch_limit:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                nxt = self._q.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if nxt is ParallelInference._SENTINEL:
+                break
+            items.append(nxt)
+        return items
+
+    def _worker_loop(self):
+        while not self._stop.is_set():
+            items = self._collect()
             if not items:
-                return
+                continue
             xs = [i[0] for i in items]
             sizes = [len(x) for x in xs]
-            big = np.concatenate(xs, axis=0)
-            out = self.output(big)
-            ofs = 0
-            for (x, slot, done), n in zip(items, sizes):
-                slot["out"] = out[ofs:ofs + n]
-                ofs += n
-                done.set()
+            try:
+                out = self.output(np.concatenate(xs, axis=0))
+                ofs = 0
+                for (x, obs), n in zip(items, sizes):
+                    obs._resolve(out[ofs:ofs + n])
+                    ofs += n
+            except BaseException as e:
+                for _, obs in items:
+                    obs._fail(e)
+            self.requests_served += len(items)
+            self.batches_dispatched += 1
+            self.batch_sizes.append(len(items))

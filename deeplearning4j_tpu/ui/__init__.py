@@ -6,5 +6,6 @@ listener + play server); see ``ui/stats.py`` and ``ui/server.py``.
 
 from deeplearning4j_tpu.ui.stats import StatsListener
 from deeplearning4j_tpu.ui.server import UIServer, dashboard_html
+from deeplearning4j_tpu.ui import components  # noqa: F401
 
 __all__ = ["StatsListener", "UIServer", "dashboard_html"]

@@ -242,3 +242,55 @@ def test_cluster_trainer_watchdog_smoke():
     trainer.fit_local_shard(ds, num_epochs=2, collective_timeout_s=60.0,
                             watchdog_every=1)
     assert net.score() is not None
+
+
+def test_parallel_inference_dynamic_batching():
+    """BatchedInferenceObservable contract (reference
+    ParallelInference.java:97-134): concurrent submits coalesce into shared
+    device dispatches, every caller gets ITS slice, latency stays bounded."""
+    import threading
+    import time as _time
+
+    net = _net(seed=9)
+    ds = _iris_batch(96)
+    net.fit(ds)
+    pi = ParallelInference(net, batch_limit=16, queue_timeout_ms=30)
+
+    want = np.asarray(pi.output(ds.features))
+    n_threads, per = 12, 4
+    outs = [None] * n_threads
+    lat = [0.0] * n_threads
+
+    def worker(i):
+        x = ds.features[i * per:(i + 1) * per]
+        t0 = _time.perf_counter()
+        outs[i] = pi.output_batched(x)
+        lat[i] = _time.perf_counter() - t0
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for i in range(n_threads):
+        np.testing.assert_allclose(outs[i], want[i * per:(i + 1) * per],
+                                   rtol=1e-5, atol=1e-6)
+    assert pi.requests_served == n_threads
+    # coalescing happened: fewer dispatches than requests
+    assert pi.batches_dispatched < n_threads, pi.batch_sizes
+    assert max(pi.batch_sizes) > 1
+    assert max(lat) < 20.0  # bounded latency even under contention
+    pi.shutdown()
+
+    # observable API: async submit, late get
+    obs = pi.submit(ds.features[:3])
+    out = obs.get(timeout=10)
+    assert out.shape == (3, 3) and obs.is_done()
+    pi.shutdown()
+
+    # sequential mode parity
+    pi_seq = ParallelInference(net, inference_mode="sequential")
+    np.testing.assert_allclose(pi_seq.output_batched(ds.features[:5]),
+                               want[:5], rtol=1e-5, atol=1e-6)
+    assert pi_seq.batches_dispatched == 0  # no worker involved

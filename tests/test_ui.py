@@ -305,3 +305,53 @@ def test_inline_js_structural_contract():
         for route in set(re.findall(r"""fetch\([`"'](/api/[a-z/]+)""", js)) | \
                 set(re.findall(r"""j\([`"'](/api/[a-z/]+)""", js)):
             assert route in served, f"{name}: JS fetches unserved {route}"
+
+
+# ---------------------------------------------------------------------------
+# ui-components standalone chart/report library (reference
+# deeplearning4j-ui-components Component hierarchy + JSON serde)
+
+def test_ui_components_json_round_trip_and_render():
+    from deeplearning4j_tpu.ui.components import (
+        ChartHistogram, ChartHorizontalBar, ChartLine, ChartScatter,
+        ChartStackedArea, ChartTimeline, ComponentDiv, ComponentTable,
+        ComponentText, DecoratorAccordion, Style, component_from_json,
+        render_page,
+    )
+
+    comps = [
+        ChartLine("loss", Style(width=300)).add_series(
+            "train", [0, 1, 2, 3], [2.0, 1.2, 0.7, 0.4]).add_series(
+            "val", [0, 1, 2, 3], [2.1, 1.5, 1.0, 0.9]),
+        ChartScatter("embedding").add_series("pts", [1, 2, 3], [3, 1, 2]),
+        ChartHistogram("weights").add_bin(-1, 0, 10).add_bin(0, 1, 30),
+        ChartHorizontalBar("per-class F1").add_value("cat", 0.91)
+                                          .add_value("dog", 0.84),
+        ChartStackedArea("phase time").set_x([0, 1, 2])
+            .add_series("fwd", [1, 1.1, 1.0]).add_series("bwd", [2, 2.2, 2.1]),
+        ChartTimeline("epochs").add_lane(
+            "worker0", [(0.0, 1.0, "e0"), (1.2, 2.0, "e1")]),
+        ComponentTable(["metric", "value"]).add_row("accuracy", "0.97"),
+        ComponentText("Training summary"),
+    ]
+    page_comps = [DecoratorAccordion("details", comps[0], comps[6],
+                                     default_collapsed=False),
+                  ComponentDiv(*comps[1:6]), comps[7]]
+
+    # JSON round trip of EVERY component type preserves structure + render
+    for c in comps + page_comps:
+        c2 = component_from_json(c.to_json())
+        assert type(c2) is type(c)
+        assert c2.to_dict() == c.to_dict()
+        assert c2.render_html() == c.render_html()
+
+    html = render_page(page_comps, title="run report")
+    assert html.startswith("<!DOCTYPE html>")
+    assert html.count("<svg") == 6
+    assert "per-class F1" in html and "accuracy" in html
+    assert "<details open>" in html
+    # self-contained: no external refs
+    assert "http://" not in html.replace("http://www.w3.org", "")
+    # XSS: user strings are escaped
+    from deeplearning4j_tpu.ui.components import ComponentText as CT
+    assert "<script>" not in CT("<script>alert(1)</script>").render_html()
