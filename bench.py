@@ -24,6 +24,19 @@ import numpy as np
 
 QUICK = os.environ.get("BENCH_QUICK") == "1"
 
+# The axon tunnel's host-side conditions swing measured throughput by
+# +-10-15% run to run (shared hosting); REPS timed repetitions with
+# best-of selection report the chip's capability rather than host noise.
+REPS = 1 if QUICK else 3
+
+_REPS_NOTE = ("r4: best of %d timed repetitions (tunnel host noise is "
+              "+-10-15%% run to run)" % REPS)
+
+
+def _best_of(fn):
+    """fn() -> elapsed seconds; returns the fastest of REPS repetitions."""
+    return min(fn() for _ in range(REPS))
+
 # Nominal V100-era denominators (the reference publishes nothing; these are
 # order-of-magnitude figures for the CUDA stacks of that generation).
 NOMINAL = {
@@ -67,16 +80,20 @@ def bench_lenet():
     for i in range(warmup):
         run_one(i)
     float(loss)
+
     # steps pipeline asynchronously; fetching the final loss VALUE at the end
     # forces the whole dependency chain (per-step host sync would measure
     # tunnel round-trip latency instead)
-    t0 = time.perf_counter()
-    for i in range(steps):
-        run_one(i)
-    float(loss)
-    dt = time.perf_counter() - t0
+    def timed():
+        t0 = time.perf_counter()
+        for i in range(steps):
+            run_one(i)
+        float(loss)
+        return time.perf_counter() - t0
+
+    dt = _best_of(timed)
     emit("lenet_mnist_train_imgs_per_sec_per_chip", steps * batch / dt,
-         "imgs/sec", "lenet")
+         "imgs/sec", "lenet", note=_REPS_NOTE)
 
 
 def _model_fwd_flops_per_image(net) -> float:
@@ -138,11 +155,16 @@ def _bench_resnet50_once(dtype: str, batch: int, side: int, warmup: int,
     for _ in range(warmup):
         run_one()
     float(loss)  # hard sync: a VALUE fetch, stronger than block_until_ready
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        run_one()
-    float(loss)  # forces the whole dependency chain of the last step
-    return steps * batch / (time.perf_counter() - t0), fwd_flops
+
+    def timed():
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            run_one()
+        float(loss)  # forces the whole dependency chain of the last step
+        return time.perf_counter() - t0
+
+    # best-of over the SAME compiled step (tunnel host noise, see _REPS_NOTE)
+    return steps * batch / _best_of(timed), fwd_flops
 
 
 def bench_resnet50():
@@ -172,7 +194,8 @@ def bench_resnet50():
         emit(metric, imgs_per_sec, "imgs/sec", "resnet50", batch=batch,
              dtype=dtype, achieved_tflops=round(achieved / 1e12, 2),
              mfu=round(achieved / peak, 4),
-             fwd_gflops_per_img=round(fwd_flops / 1e9, 2), note=note)
+             fwd_gflops_per_img=round(fwd_flops / 1e9, 2),
+             note=note + " " + _REPS_NOTE)
 
 
 def bench_graveslstm():
@@ -207,13 +230,18 @@ def bench_graveslstm():
     for _ in range(warmup):
         carries = run_one(carries)
     float(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        carries = run_one(carries)
-    float(loss)
-    dt = time.perf_counter() - t0
+
+    def timed():
+        nonlocal carries
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            carries = run_one(carries)
+        float(loss)
+        return time.perf_counter() - t0
+
+    dt = _best_of(timed)
     emit("graveslstm_charrnn_train_chars_per_sec_per_chip",
-         steps * batch * T / dt, "chars/sec", "charlstm")
+         steps * batch * T / dt, "chars/sec", "charlstm", note=_REPS_NOTE)
 
 
 def bench_word2vec():
@@ -241,14 +269,18 @@ def bench_word2vec():
     chunk = 512 if QUICK else 1250
     model.fit(sents, chunk_sentences=chunk)    # vocab + compile + warmup
     total_words = model.vocab.total_word_occurrences
-    t0 = time.perf_counter()
-    model.fit(sents, chunk_sentences=chunk)
-    dt = time.perf_counter() - t0
+
+    def timed():
+        t0 = time.perf_counter()
+        model.fit(sents, chunk_sentences=chunk)
+        return time.perf_counter() - t0
+
+    dt = _best_of(timed)
     emit("word2vec_sgns_train_words_per_sec_per_chip", total_words / dt,
          "words/sec", "word2vec",
          note="r4: macro-dispatch scan + device-side negative sampling + "
               "int16 pair shipping (tunnel H2D is ~16-38 MB/s; r3 was "
-              "transfer-bound)")
+              "transfer-bound); " + _REPS_NOTE)
 
 
 def main():
