@@ -85,7 +85,8 @@ class ComputationGraph:
             state[name] = s
         self.params = params
         self.state = state
-        self.opt_state = {n: self._txs[n].init(params[n]) for n in self._layer_names}
+        self.opt_state = {n: self._txs[n].init(params[n])
+                          for n in self._layer_names}
         self._rng = rng
         return self
 
@@ -248,14 +249,7 @@ class ComputationGraph:
                  fmasks, lmasks):
             (loss, (new_state, new_carries)), grads = value_and_grad(
                 params, state, carries, inputs, labels, rng, fmasks, lmasks)
-            new_params = dict(params)
-            new_opt = dict(opt_state)
-            for n in self._layer_names:
-                g = self._gnorms[n](grads[n])
-                updates, os = self._txs[n].update(g, opt_state[n], params[n])
-                new_params[n] = apply_constraints(
-                    self.vertices[n][0], optax.apply_updates(params[n], updates))
-                new_opt[n] = os
+            new_params, new_opt = self._apply_updates(params, grads, opt_state)
             return new_params, new_state, new_opt, new_carries, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))
@@ -349,20 +343,30 @@ class ComputationGraph:
     def rnn_get_previous_state(self):
         return self._rnn_carries
 
+    def _apply_updates(self, params, grads, opt_state):
+        """Optimizer application shared by the standard and tBPTT steps.
+
+        Per-vertex update chains are kept (vs one whole-tree optax
+        transform, measured r4: no step-time difference on ResNet50) —
+        they preserve wrapper-layer constraints, tensor-parallel opt-state
+        placement, and checkpoint compatibility."""
+        new_params = dict(params)
+        new_opt = dict(opt_state)
+        for n in self._layer_names:
+            g = self._gnorms[n](grads[n])
+            updates, os = self._txs[n].update(g, opt_state[n], params[n])
+            new_params[n] = apply_constraints(
+                self.vertices[n][0], optax.apply_updates(params[n], updates))
+            new_opt[n] = os
+        return new_params, new_opt
+
     def _make_train_step(self):
         value_and_grad = jax.value_and_grad(self._loss_fn, has_aux=True)
 
         def step(params, state, opt_state, rng, inputs, labels, fmasks, lmasks):
             (loss, new_state), grads = value_and_grad(
                 params, state, inputs, labels, rng, fmasks, lmasks)
-            new_params = dict(params)
-            new_opt = dict(opt_state)
-            for n in self._layer_names:
-                g = self._gnorms[n](grads[n])
-                updates, os = self._txs[n].update(g, opt_state[n], params[n])
-                new_params[n] = apply_constraints(
-                    self.vertices[n][0], optax.apply_updates(params[n], updates))
-                new_opt[n] = os
+            new_params, new_opt = self._apply_updates(params, grads, opt_state)
             return new_params, new_state, new_opt, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
