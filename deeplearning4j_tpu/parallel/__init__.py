@@ -9,6 +9,9 @@ from deeplearning4j_tpu.parallel.stats import TrainingStats  # noqa: F401
 from deeplearning4j_tpu.parallel.watchdog import (  # noqa: F401
     CollectiveTimeoutError, CollectiveWatchdog,
 )
+from deeplearning4j_tpu.parallel.ulysses import (  # noqa: F401
+    ulysses_self_attention,
+)
 from deeplearning4j_tpu.parallel.ring_attention import (  # noqa: F401
     flash_self_attention,
     reference_attention,

@@ -1,0 +1,67 @@
+"""All-to-all (Ulysses-style) sequence/context parallelism.
+
+The second long-context scheme next to ``ring_attention``: instead of
+streaming K/V blocks around a ring, ONE ``all_to_all`` re-shards the
+sequence axis into the heads axis so every device runs FULL-sequence
+attention for its head group, then a second ``all_to_all`` restores the
+sequence sharding (DeepSpeed-Ulysses; public recipe — the reference has no
+attention at all, see ring_attention.py docstring).
+
+Trade-offs vs the ring (both kept, pick per workload):
+- communication: 2 all-to-alls of activation size, independent of sequence
+  length in VOLUME per device, vs n-1 ppermute rounds of K/V — Ulysses wins
+  when heads >= devices and ICI all-to-all bandwidth is good;
+- memory: full (t, t_local-free) attention per head group — the softmax is
+  over the FULL sequence, so per-device score memory is O(t^2 * h_local),
+  vs the ring's O(t_local^2 * h). Ring scales to longer t; Ulysses is
+  simpler and faster at moderate t.
+
+Requires heads % n_devices == 0 (the classic Ulysses constraint).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.ring_attention import reference_attention
+
+
+def _ulysses_block(q, k, v, axis_name: str, causal: bool):
+    """Per-device body: q/k/v arrive as (b, h, t_local, d) sequence shards,
+    leave the same way. Inside, heads are sharded and time is full."""
+    # (b, h, t/P, d) -> (b, h/P, t, d): split heads (axis 1), gather time
+    # (axis 2). tiled=True keeps plain array semantics.
+    def scatter_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def gather_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    o = reference_attention(qh, kh, vh, causal=causal)
+    return gather_heads(o)
+
+
+def ulysses_self_attention(q, k, v, mesh: Mesh, axis_name: str = "data",
+                           causal: bool = False):
+    """Sequence-parallel attention via head/sequence all-to-all:
+    (b, h, t, d) with t sharded over ``axis_name``. Numerically equal to
+    ``reference_attention`` on the gathered sequence (exact softmax — no
+    online accumulation involved). heads must divide by the axis size."""
+    n = mesh.shape[axis_name]
+    if q.shape[1] % n != 0:
+        raise ValueError(
+            f"Ulysses needs heads ({q.shape[1]}) divisible by the "
+            f"'{axis_name}' axis size ({n}); use ring_self_attention for "
+            "head counts below the mesh size")
+    spec = P(None, None, axis_name, None)
+    f = jax.shard_map(
+        functools.partial(_ulysses_block, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return f(q, k, v)

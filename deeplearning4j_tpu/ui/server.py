@@ -426,6 +426,18 @@ class _Handler(BaseHTTPRequestHandler):
         elif url.path == "/api/activations/data":
             self._json(st.get_all_updates(session, ACTIVATIONS_TYPE_ID)
                        if st else [])
+        elif url.path == "/api/i18n":
+            # reference I18N route: language-keyed UI labels
+            from deeplearning4j_tpu.ui.i18n import DefaultI18N
+            lang = q.get("lang", [None])[0]
+            i18n = DefaultI18N.get_instance()
+            if lang is not None and lang not in i18n.languages():
+                self._send(400, f"Unknown language '{lang}' "
+                           f"(have {i18n.languages()})".encode(), "text/plain")
+            else:
+                self._json({"language": lang or i18n.get_default_language(),
+                            "languages": i18n.languages(),
+                            "messages": i18n.messages(lang)})
         else:
             self._send(404, b"not found", "text/plain")
 

@@ -355,3 +355,46 @@ def test_ui_components_json_round_trip_and_render():
     # XSS: user strings are escaped
     from deeplearning4j_tpu.ui.components import ComponentText as CT
     assert "<script>" not in CT("<script>alert(1)</script>").render_html()
+
+
+def test_i18n_messages_and_route():
+    """reference DefaultI18N.java: language-keyed messages + fallback."""
+    from deeplearning4j_tpu.ui.i18n import DefaultI18N
+
+    i18n = DefaultI18N.get_instance()
+    assert i18n is DefaultI18N.get_instance()
+    assert i18n.get_message("train.pagetitle") == "Training UI"
+    assert i18n.get_message("train.pagetitle", "de") == "Trainings-UI"
+    assert i18n.get_message("train.nav.overview", "ja") == "概要"
+    # fallback chain: unknown key -> key; unknown lang -> English
+    assert i18n.get_message("no.such.key", "de") == "no.such.key"
+    assert i18n.get_message("train.pagetitle", "xx") == "Training UI"
+    assert set(i18n.languages()) >= {"en", "de", "ja", "zh"}
+    i18n.set_default_language("de")
+    try:
+        assert i18n.get_message("train.session") == "Sitzung"
+    finally:
+        i18n.set_default_language("en")
+    with pytest.raises(ValueError):
+        i18n.set_default_language("tlh")
+
+    server = UIServer(port=0).attach(InMemoryStatsStorage())
+    try:
+        base = f"http://localhost:{server.port}"
+        d = json.loads(urllib.request.urlopen(f"{base}/api/i18n?lang=zh").read())
+        assert d["messages"]["train.system.memory"] == "内存"
+        assert "en" in d["languages"]
+    finally:
+        server.stop()
+
+
+def test_i18n_unknown_lang_is_400():
+    server = UIServer(port=0).attach(InMemoryStatsStorage())
+    try:
+        base = f"http://localhost:{server.port}"
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/api/i18n?lang=tlh")
+        assert ei.value.code == 400
+    finally:
+        server.stop()
