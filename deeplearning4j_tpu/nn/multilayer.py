@@ -208,22 +208,138 @@ class MultiLayerNetwork:
         loss = loss + self._regularization(params)
         return loss, new_state
 
+    def _apply_updates(self, params, grads, opt_state):
+        """Per-layer optimizer application shared by the standard, fused and
+        tBPTT steps."""
+        new_params = []
+        new_opt = []
+        for i, tx in enumerate(self._txs):
+            g = self._gnorms[i](grads[i])
+            updates, os = tx.update(g, opt_state[i], params[i])
+            new_params.append(apply_constraints(
+                self.layers[i], optax.apply_updates(params[i], updates)))
+            new_opt.append(os)
+        return new_params, new_opt
+
     def _make_train_step(self):
         value_and_grad = jax.value_and_grad(self._loss_fn, has_aux=True)
 
         def step(params, state, opt_state, rng, x, y, fmask, lmask):
             (loss, new_state), grads = value_and_grad(params, state, x, y, rng, fmask, lmask)
-            new_params = []
-            new_opt = []
-            for i, tx in enumerate(self._txs):
-                g = self._gnorms[i](grads[i])
-                updates, os = tx.update(g, opt_state[i], params[i])
-                new_params.append(apply_constraints(
-                    self.layers[i], optax.apply_updates(params[i], updates)))
-                new_opt.append(os)
+            new_params, new_opt = self._apply_updates(params, grads, opt_state)
             return new_params, new_state, new_opt, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _make_fused_train_step(self):
+        """K sequential optimizer steps fused into ONE dispatch via lax.scan
+        over stacked (K, batch, ...) minibatches — identical math to K
+        ``fit`` calls (same per-step rng split chain), but the host pays one
+        dispatch instead of K. On dispatch-latency-bound paths (small
+        models, high-latency links) this is the throughput path; see
+        ``fit_fused``."""
+        value_and_grad = jax.value_and_grad(self._loss_fn, has_aux=True)
+
+        def fused(params, state, opt_state, rng, xs, ys, fmasks, lmasks):
+            def body(carry, inp):
+                params, state, opt_state, rng = carry
+                x, y, fm, lm = inp
+                rng, k = jax.random.split(rng)   # same chain as fit()
+                (loss, new_state), grads = value_and_grad(
+                    params, state, x, y, k, fm, lm)
+                new_params, new_opt = self._apply_updates(
+                    params, grads, opt_state)
+                return (new_params, new_state, new_opt, rng), loss
+
+            (params, state, opt_state, rng), losses = jax.lax.scan(
+                body, (params, state, opt_state, rng),
+                (xs, ys, fmasks, lmasks))
+            return params, state, opt_state, rng, losses
+
+        # two compiled variants: with and without masks (None is not
+        # scannable, so maskless groups pass no mask operands)
+        def fused_nomask(params, state, opt_state, rng, xs, ys):
+            def body(carry, inp):
+                params, state, opt_state, rng = carry
+                x, y = inp
+                rng, k = jax.random.split(rng)
+                (loss, new_state), grads = value_and_grad(
+                    params, state, x, y, k, None, None)
+                new_params, new_opt = self._apply_updates(
+                    params, grads, opt_state)
+                return (new_params, new_state, new_opt, rng), loss
+
+            (params, state, opt_state, rng), losses = jax.lax.scan(
+                body, (params, state, opt_state, rng), (xs, ys))
+            return params, state, opt_state, rng, losses
+
+        return (jax.jit(fused, donate_argnums=(0, 1, 2)),
+                jax.jit(fused_nomask, donate_argnums=(0, 1, 2)))
+
+    def fit_fused(self, datasets) -> "MultiLayerNetwork":
+        """Train on a list of equally-shaped DataSets — or a pre-stacked
+        ``(xs, ys)`` pair of (K, batch, ...) arrays — in ONE device dispatch
+        (lax.scan over the stack). Equivalent to ``fit`` on each in order
+        for the jitted SGD-family path (raises for solver/tbptt configs);
+        per-step feature/label masks are threaded when any DataSet carries
+        them. Listeners fire once per fused group (with the last step's
+        score) and ``iteration`` advances by the group size. Pass
+        device-resident stacked arrays when re-fitting the same data (a
+        fresh host stack re-uploads the whole group each call)."""
+        if self.params is None:
+            self.init()
+        if self.conf.optimization_algo not in ("sgd",
+                                               "stochastic_gradient_descent"):
+            raise ValueError("fit_fused supports the jitted SGD-family path "
+                             "only; use fit() for solver-based optimization")
+        if self.conf.backprop_type == "tbptt":
+            raise ValueError("fit_fused does not window tBPTT sequences; "
+                             "use fit() for tbptt-configured networks")
+        fmasks = lmasks = None
+        if isinstance(datasets, tuple) and len(datasets) == 2:
+            xa, ya = datasets
+            if not (hasattr(xa, "shape") and hasattr(ya, "shape")):
+                raise TypeError(
+                    "fit_fused((a, b)) expects pre-stacked (K, batch, ...) "
+                    "ARRAYS; pass multiple DataSets as a list")
+            xs, ys = jnp.asarray(xa), jnp.asarray(ya)
+            if xs.ndim < 3:
+                raise ValueError(
+                    "pre-stacked inputs must be (K, batch, ...); for one "
+                    "batch of (features, labels) use fit()")
+            n_steps = int(xs.shape[0])
+        else:
+            xs = jnp.stack([jnp.asarray(d.features) for d in datasets])
+            ys = jnp.stack([jnp.asarray(d.labels) for d in datasets])
+            n_steps = len(datasets)
+            if any(d.features_mask is not None or d.labels_mask is not None
+                   for d in datasets):
+                ones = lambda d, m, like: (np.ones(like, np.float32)
+                                           if m is None else np.asarray(m))
+                fmasks = jnp.stack([
+                    jnp.asarray(ones(d, d.features_mask,
+                                     d.features.shape[:2]))
+                    for d in datasets])
+                lmasks = jnp.stack([
+                    jnp.asarray(ones(d, d.labels_mask, d.labels.shape[:2]))
+                    for d in datasets])
+        step_masked, step_nomask = self._get_jitted("train_fused")
+        if fmasks is not None:
+            self.params, self.state, self.opt_state, self._rng, losses = \
+                step_masked(self.params, self.state, self.opt_state,
+                            self._rng, xs, ys, fmasks, lmasks)
+        else:
+            self.params, self.state, self.opt_state, self._rng, losses = \
+                step_nomask(self.params, self.state, self.opt_state,
+                            self._rng, xs, ys)
+        self._score = losses[-1]
+        self.last_batch_size = int(xs.shape[1])
+        self._last_features = xs[-1][:1]
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration + n_steps - 1,
+                                    self.epoch)
+        self.iteration += n_steps
+        return self
 
     # ------------------------------------------------- truncated BPTT / state
     def _zero_carries(self, batch: int):
@@ -310,6 +426,8 @@ class MultiLayerNetwork:
         if fn is None:
             if kind == "train":
                 fn = self._make_train_step()
+            elif kind == "train_fused":
+                fn = self._make_fused_train_step()
             elif kind == "tbptt":
                 fn = self._make_tbptt_step()
             elif kind == "rnn_step":

@@ -147,3 +147,90 @@ def test_dropout_only_active_in_training():
     o1 = net.output(x)
     o2 = net.output(x)
     np.testing.assert_allclose(o1, o2)  # inference is deterministic
+
+
+def test_fit_fused_matches_sequential():
+    """fit_fused = K sequential fit() calls in one dispatch: identical
+    parameter trajectory (same rng split chain)."""
+    import jax
+
+    def make():
+        conf = (NeuralNetConfiguration.builder()
+                .seed(11).updater(Adam(1e-2)).weight_init("xavier").list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    batches = [DataSet(rng.standard_normal((16, 4)).astype(np.float32),
+                       np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)])
+               for _ in range(5)]
+    seq = make()
+    for ds in batches:
+        seq.fit(ds)
+    fused = make()
+    fused.fit_fused(batches)
+    assert fused.iteration == 5
+    for a, b in zip(jax.tree_util.tree_leaves(seq.params),
+                    jax.tree_util.tree_leaves(fused.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(seq.score(), fused.score(), rtol=1e-5)
+    # pre-stacked (xs, ys) path is the same program
+    fused2 = make()
+    xs = np.stack([d.features for d in batches])
+    ys = np.stack([d.labels for d in batches])
+    fused2.fit_fused((xs, ys))
+    for a, b in zip(jax.tree_util.tree_leaves(fused.params),
+                    jax.tree_util.tree_leaves(fused2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_fit_fused_masks_and_guards():
+    """Masked DataSets thread their per-step masks through the fused scan;
+    solver/tbptt configs and malformed tuples are rejected."""
+    from deeplearning4j_tpu.nn.conf.recurrent import LSTM, RnnOutputLayer
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater(Adam(5e-3)).weight_init("xavier").list()
+            .layer(LSTM(n_out=6, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(3)).build())
+    rng = np.random.default_rng(0)
+    batches = []
+    for _ in range(3):
+        x = rng.standard_normal((4, 6, 3)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (4, 6))]
+        m = np.zeros((4, 6), np.float32)
+        m[:, :4] = 1.0  # only 4 valid steps
+        batches.append(DataSet(x, y, features_mask=m, labels_mask=m))
+    seq = MultiLayerNetwork(conf).init()
+    for ds in batches:
+        seq.fit(ds)
+    fused = MultiLayerNetwork(conf).init()
+    fused.fit_fused(batches)
+    import jax
+    for a, b in zip(jax.tree_util.tree_leaves(seq.params),
+                    jax.tree_util.tree_leaves(fused.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    with pytest.raises(TypeError, match="pre-stacked"):
+        fused.fit_fused((batches[0], batches[1]))
+    with pytest.raises(ValueError, match="K, batch"):
+        fused.fit_fused((np.ones((4, 3), np.float32),
+                         np.ones((4, 2), np.float32)))
+
+    tconf = (NeuralNetConfiguration.builder()
+             .seed(3).updater(Adam(5e-3)).weight_init("xavier").list()
+             .layer(LSTM(n_out=6, activation="tanh"))
+             .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+             .set_input_type(InputType.recurrent(3))
+             .backprop_type("tbptt", fwd_length=3, back_length=3).build())
+    with pytest.raises(ValueError, match="tbptt"):
+        MultiLayerNetwork(tconf).init().fit_fused(batches)
