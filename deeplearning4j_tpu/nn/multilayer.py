@@ -430,6 +430,8 @@ class MultiLayerNetwork:
                 fn = self._make_fused_train_step()
             elif kind == "tbptt":
                 fn = self._make_tbptt_step()
+            elif kind == "tbptt_fused":
+                fn = self._make_tbptt_scan_step()
             elif kind == "rnn_step":
                 fn = jax.jit(lambda params, state, carries, x:
                              (lambda r: (r[0][-1], r[4]))(
@@ -594,6 +596,70 @@ class MultiLayerNetwork:
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration, self.epoch)
         self.iteration += 1
+
+    def _make_tbptt_scan_step(self):
+        """All tBPTT windows of one sequence batch fused into ONE dispatch:
+        lax.scan over (W, batch, L, ...) window stacks, threading the RNN
+        carries through the scan carry. Gradient truncation semantics are
+        IDENTICAL to the per-window loop — each scan iteration runs its own
+        value_and_grad, and the carries passed forward are values, not
+        differentiated across windows. Same rng split chain as _fit_tbptt."""
+        value_and_grad = jax.value_and_grad(self._loss_fn_tbptt, has_aux=True)
+
+        def fused(params, state, opt_state, carries, rng, xw, yw):
+            def body(c, inp):
+                params, state, opt_state, carries, rng = c
+                x, y = inp
+                rng, k = jax.random.split(rng)
+                (loss, (new_state, new_carries)), grads = value_and_grad(
+                    params, state, carries, x, y, k, None, None)
+                new_params, new_opt = self._apply_updates(
+                    params, grads, opt_state)
+                return (new_params, new_state, new_opt, new_carries,
+                        rng), loss
+
+            (params, state, opt_state, carries, rng), losses = jax.lax.scan(
+                body, (params, state, opt_state, carries, rng), (xw, yw))
+            return params, state, opt_state, carries, rng, losses
+
+        return jax.jit(fused, donate_argnums=(0, 1, 2, 3))
+
+    def fit_tbptt_fused(self, x, y) -> "MultiLayerNetwork":
+        """Train one (batch, T, ...) sequence batch with ALL full tBPTT
+        windows fused into one dispatch (T must be a multiple of
+        ``tbptt_fwd_length``; masks unsupported — use ``fit``). Exactly
+        equivalent to the per-window path; listeners fire once per call and
+        ``iteration`` advances by the window count."""
+        if self.params is None:
+            self.init()
+        if self.conf.backprop_type != "tbptt":
+            raise ValueError("fit_tbptt_fused requires backprop_type='tbptt' "
+                             "(this network is 'standard'; use fit/fit_fused)")
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        L = self.conf.tbptt_fwd_length
+        T = int(x.shape[1])
+        if T % L != 0:
+            raise ValueError(f"sequence length {T} must be a multiple of "
+                             f"tbptt_fwd_length {L} for the fused path")
+        w = T // L
+        b = int(x.shape[0])
+        # (b, T, ...) -> (W, b, L, ...)
+        xw = jnp.moveaxis(x.reshape((b, w, L) + x.shape[2:]), 1, 0)
+        yw = (jnp.moveaxis(y.reshape((b, w, L) + y.shape[2:]), 1, 0)
+              if y.ndim == 3 else jnp.broadcast_to(y, (w,) + y.shape))
+        carries = self._zero_carries(b)
+        step = self._get_jitted("tbptt_fused")
+        (self.params, self.state, self.opt_state, _, self._rng,
+         losses) = step(self.params, self.state, self.opt_state, carries,
+                        self._rng, xw, yw)
+        self._score = losses[-1]
+        self.last_batch_size = b
+        self._last_features = x[:1]
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration + w - 1, self.epoch)
+        self.iteration += w
+        return self
 
     def _fit_tbptt(self, x, y, fm, lm):
         """Chunked fit over time windows (reference doTruncatedBPTT

@@ -245,3 +245,47 @@ def test_bidirectional_rnn_time_step_raises():
                    InputType.recurrent(3))
     with pytest.raises(NotImplementedError):
         net.rnn_time_step(np.zeros((1, 3), np.float32))
+
+
+def test_fit_tbptt_fused_matches_per_window():
+    """fit_tbptt_fused = the per-window tBPTT loop in one dispatch: same rng
+    chain, same truncation, identical parameter trajectory."""
+    import jax
+
+    def make():
+        conf = (NeuralNetConfiguration.builder()
+                .seed(21).updater(Adam(5e-3)).weight_init("xavier").list()
+                .layer(LSTM(n_out=10, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=4, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(4))
+                .backprop_type("tbptt", fwd_length=5, back_length=5)
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(3)
+    idx = rng.integers(0, 4, (6, 20))
+    x = np.eye(4, dtype=np.float32)[idx]
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (6, 20))]
+
+    seq = make()
+    seq.fit(DataSet(x, y))            # 4 windows via the per-window loop
+    fused = make()
+    fused.fit_tbptt_fused(x, y)       # same 4 windows, one dispatch
+    assert fused.iteration == seq.iteration == 4
+    for a, b in zip(jax.tree_util.tree_leaves(seq.params),
+                    jax.tree_util.tree_leaves(fused.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(seq.score(), fused.score(), rtol=1e-5)
+    with pytest.raises(ValueError, match="multiple"):
+        fused.fit_tbptt_fused(x[:, :18], y[:, :18])
+    # non-tbptt nets are rejected instead of silently truncating gradients
+    plain = (NeuralNetConfiguration.builder()
+             .seed(21).updater(Adam(5e-3)).weight_init("xavier").list()
+             .layer(LSTM(n_out=10, activation="tanh"))
+             .layer(RnnOutputLayer(n_out=4, activation="softmax",
+                                   loss="mcxent"))
+             .set_input_type(InputType.recurrent(4)).build())
+    with pytest.raises(ValueError, match="backprop_type"):
+        MultiLayerNetwork(plain).init().fit_tbptt_fused(x, y)
