@@ -23,6 +23,10 @@ from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
     AsyncMultiDataSetIterator,
     EarlyTerminationMultiDataSetIterator,
 )
+from deeplearning4j_tpu.datasets.streaming import (  # noqa: F401
+    StreamingDataSetIterator,
+    StreamingHttpReceiver,
+)
 from deeplearning4j_tpu.datasets.records import (  # noqa: F401
     RecordReader,
     CollectionRecordReader,

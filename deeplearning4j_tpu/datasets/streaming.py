@@ -1,0 +1,151 @@
+"""Streaming batch ingestion: push-driven DataSet iterators.
+
+Parity surface: reference dl4j-streaming
+(``streaming/routes/CamelKafkaRouteBuilder.java:1`` — DataVec records
+arriving over Kafka/Camel feed a training loop) and
+``spark/iterator/PortableDataStreamDataSetIterator``. The capability — an
+EXTERNAL producer pushes batches into a live ``fit()`` — is what matters;
+the Kafka/Camel fabric itself is a JVM-ecosystem integration (README
+"Scope decisions").
+
+TPU-native design: a bounded queue decouples the producer from the
+device-bound training loop exactly like the AsyncDataSetIterator prefetch
+path, so the training thread blocks only when the feed runs dry.
+``StreamingHttpReceiver`` adds a minimal HTTP front door (POST npz batches)
+for producers in other processes/languages.
+"""
+
+from __future__ import annotations
+
+import io
+import queue
+import threading
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+
+
+class StreamingDataSetIterator(DataSetIterator):
+    """Unbounded-duration, push-driven iterator.
+
+    Producers call :meth:`push` (any thread) with a DataSet or
+    (features, labels) arrays; the consumer side is an ordinary
+    DataSetIterator usable with ``net.fit``. Iteration blocks waiting for
+    batches and ends when a producer calls :meth:`end` (one fit pass ==
+    one stream segment; a later iteration consumes the next segment from
+    the same live queue).
+    """
+
+    _END = object()
+
+    def __init__(self, queue_size: int = 16,
+                 poll_timeout: Optional[float] = None):
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self._poll_timeout = poll_timeout
+        self.pushed = 0
+        self.consumed = 0
+        self._bs: Optional[int] = None
+
+    # ------------------------------------------------------------ producer
+    def push(self, features, labels=None, features_mask=None,
+             labels_mask=None, timeout: Optional[float] = None):
+        """Enqueue one batch; blocks while the queue is full (backpressure).
+        Accepts a DataSet or raw arrays."""
+        if isinstance(features, DataSet):
+            ds = features
+        else:
+            ds = DataSet(np.asarray(features),
+                         None if labels is None else np.asarray(labels),
+                         features_mask=features_mask,
+                         labels_mask=labels_mask)
+        self._q.put(ds, timeout=timeout)
+        if self._bs is None:
+            self._bs = int(ds.features.shape[0])
+        self.pushed += 1
+        return self
+
+    def end(self):
+        """Mark end of the current stream segment: the consuming iteration
+        finishes once everything queued before this call is drained."""
+        self._q.put(StreamingDataSetIterator._END)
+        return self
+
+    # ------------------------------------------------------------ consumer
+    def _generate(self):
+        while True:
+            try:
+                item = self._q.get(timeout=self._poll_timeout)
+            except queue.Empty:
+                return  # poll_timeout elapsed with no producer activity
+            if item is StreamingDataSetIterator._END:
+                return
+            self.consumed += 1
+            yield item
+
+    def reset(self):  # streams have no rewind; reset is a no-op
+        pass
+
+    def batch_size(self):
+        return self._bs or 0
+
+
+class StreamingHttpReceiver:
+    """HTTP front door for :class:`StreamingDataSetIterator`.
+
+    ``POST /push`` with an ``.npz`` body holding ``features`` and optional
+    ``labels`` / ``features_mask`` / ``labels_mask`` arrays enqueues one
+    batch; ``POST /end`` closes the current segment. The reference's
+    equivalent is the Camel route endpoint feeding DataVec records into
+    training (CamelKafkaRouteBuilder.java:1).
+    """
+
+    def __init__(self, iterator: StreamingDataSetIterator, port: int = 0):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        it = iterator
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_POST(self):
+                try:
+                    if self.path == "/end":
+                        it.end()
+                        self._ok(b"ended")
+                        return
+                    if self.path != "/push":
+                        self.send_error(404)
+                        return
+                    n = int(self.headers.get("Content-Length", 0))
+                    with np.load(io.BytesIO(self.rfile.read(n))) as z:
+                        it.push(z["features"],
+                                z["labels"] if "labels" in z else None,
+                                z["features_mask"] if "features_mask" in z
+                                else None,
+                                z["labels_mask"] if "labels_mask" in z
+                                else None)
+                    self._ok(b"ok")
+                except Exception as e:  # surface to the producer
+                    self.send_error(400, str(e))
+
+            def _ok(self, body):
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+__all__ = ["StreamingDataSetIterator", "StreamingHttpReceiver"]

@@ -51,14 +51,89 @@ class ZooModel:
         path = os.path.join(d, f"{type(self).__name__.lower()}.zip")
         return path if os.path.exists(path) else None
 
+    # -------------------------------------------------- pretrained pipeline
+    def pretrained_url(self) -> Optional[str]:
+        """URL of the pretrained archive (reference
+        ZooModel.pretrainedUrl(DataSetType)). None = no published weights.
+        The stock zoo models return None in this distribution (zero-egress
+        environment); deployments override this per model/dataset —
+        ``file://`` URLs work too."""
+        return None
+
+    def pretrained_checksum(self) -> Optional[int]:
+        """Adler-32 checksum of the archive (reference
+        ZooModel.pretrainedChecksum)."""
+        return None
+
+    @staticmethod
+    def cache_dir() -> str:
+        """reference DL4JResources.getBaseDirectory() analogue."""
+        return os.environ.get(
+            "DL4J_TPU_CACHE_DIR",
+            os.path.join(os.path.expanduser("~"), ".deeplearning4j_tpu",
+                         "models"))
+
+    @staticmethod
+    def _adler32(path: str) -> int:
+        import zlib
+        s = 1
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    break
+                s = zlib.adler32(chunk, s)
+        return s
+
+    def _fetch(self, url: str, dest: str, timeout: float = 60.0):
+        import urllib.request
+        tmp = dest + ".part"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as r, \
+                    open(tmp, "wb") as f:
+                while True:
+                    chunk = r.read(1 << 20)
+                    if not chunk:
+                        break
+                    f.write(chunk)
+        except OSError as e:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise ConnectionError(
+                f"Could not fetch pretrained weights from {url} (this "
+                "environment may have no network egress; set "
+                "DL4J_TPU_PRETRAINED_DIR to use a local archive)") from e
+        os.replace(tmp, dest)
+
     def init_pretrained(self):
-        """reference ZooModel.initPretrained :40-52 (download+checksum there;
-        local checkpoint here — zero-egress environment)."""
-        path = self.pretrained_checkpoint()
-        if path is None:
-            raise FileNotFoundError(
-                f"No pretrained checkpoint for {type(self).__name__}: set "
-                "DL4J_TPU_PRETRAINED_DIR to a directory holding "
-                f"{type(self).__name__.lower()}.zip (no network egress here)")
+        """reference ZooModel.initPretrained :40-52: resolve a local
+        override (DL4J_TPU_PRETRAINED_DIR), else download to the model
+        cache, verify the Adler-32 checksum (delete + one re-download on
+        mismatch, exactly the reference's recovery), and restore the model
+        archive into a live network."""
         from deeplearning4j_tpu.utils.serialization import restore
-        return restore(path)
+        path = self.pretrained_checkpoint()
+        if path is not None:
+            return restore(path)
+        url = self.pretrained_url()
+        if url is None:
+            raise FileNotFoundError(
+                f"No pretrained weights published for {type(self).__name__}:"
+                " set DL4J_TPU_PRETRAINED_DIR to a directory holding "
+                f"{type(self).__name__.lower()}.zip, or override "
+                "pretrained_url()")
+        os.makedirs(self.cache_dir(), exist_ok=True)
+        dest = os.path.join(self.cache_dir(),
+                            f"{type(self).__name__.lower()}.zip")
+        expect = self.pretrained_checksum()
+        for attempt in (0, 1):
+            if not os.path.exists(dest):
+                self._fetch(url, dest)
+            if expect is None or self._adler32(dest) == expect:
+                break
+            os.remove(dest)  # corrupted cache/download: retry once
+            if attempt:
+                raise IOError(
+                    f"Checksum mismatch for {dest} after re-download "
+                    f"(expected {expect})")
+        return restore(dest)

@@ -24,6 +24,9 @@ log = logging.getLogger(__name__)
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "_fastcsv.so")
+# must match fastcsv_abi_version() in fastcsv.cpp — a loaded .so whose tag
+# differs (stale binary with surviving symbols) degrades to Python fallbacks
+_ABI_VERSION = 2
 _lib = None
 _tried = False
 
@@ -59,6 +62,13 @@ def _load() -> Optional[ctypes.CDLL]:
         return None
     try:
         lib = ctypes.CDLL(_SO)
+        lib.fastcsv_abi_version.restype = ctypes.c_int64
+        lib.fastcsv_abi_version.argtypes = []
+        got = lib.fastcsv_abi_version()
+        if got != _ABI_VERSION:
+            log.info("Native library ABI %d != expected %d; "
+                     "using Python fallbacks", got, _ABI_VERSION)
+            return None
         lib.csv_shape.restype = ctypes.c_int64
         lib.csv_shape.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_char, ctypes.c_int64,

@@ -14,6 +14,12 @@
 
 extern "C" {
 
+// ABI tag checked by the ctypes loader: bump whenever any exported
+// signature or behavioral contract changes, so a stale committed-elsewhere
+// .so can never be bound to mismatched expectations on a toolchain-less
+// machine (it degrades to the Python fallback instead).
+int64_t fastcsv_abi_version(void) { return 2; }
+
 // First pass: count rows/columns. Returns 0 on success, -1 on ragged rows.
 // Rows are '\n'-separated; a trailing newline is allowed; empty lines and
 // the first skip_lines lines are ignored.

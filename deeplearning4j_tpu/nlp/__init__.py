@@ -7,16 +7,18 @@ into (center, context) index batches, and one jitted XLA program does the
 negative-sampling/hierarchical-softmax math with scatter-add updates
 (SURVEY §7 step 8's segment-sum design).
 
-Scope decision — UIMA + CJK tokenizer stacks
-(deeplearning4j-nlp-uima ~14k LoC, deeplearning4j-nlp-japanese/korean ~9k):
-NOT replicated. Those modules are thin adapters binding Apache UIMA's
-analysis-engine SPI and the Kuromoji/Arirang analyzers — JVM-ecosystem
-integrations, not model capability. The ``TokenizerFactory`` SPI here
-(nlp/tokenization.py) is the extension point they would plug into: a user
-needing CJK segmentation registers a factory wrapping any Python tokenizer
-(e.g. fugashi/konlpy) with identical downstream behavior. Everything the
-reference *trains* with those tokens (SequenceVectors/Word2Vec/
-ParagraphVectors/TF-IDF) is implemented and tokenizer-agnostic.
+CJK tokenization: ``nlp/cjk.py`` ships working Chinese (dictionary FMM),
+Japanese (script-class + particle segmentation) and Korean (eojeol + josa
+stripping) tokenizer factories behind the same SPI, so zh/ja/ko corpora
+train end-to-end out of the box. They are lightweight equivalents of the
+reference's bundled stacks (deeplearning4j-nlp-chinese ansj wrapper,
+deeplearning4j-nlp-japanese kuromoji fork, deeplearning4j-nlp-korean);
+a user who wants full morphological analysis can still register a factory
+wrapping any Python analyzer (e.g. fugashi/konlpy) — the downstream
+trainers (SequenceVectors/Word2Vec/ParagraphVectors/TF-IDF) are
+tokenizer-agnostic. The UIMA adapter stack (deeplearning4j-nlp-uima ~14k
+LoC, Apache-UIMA JVM SPI binding) remains scoped out as a JVM-ecosystem
+integration with no Python-side equivalent surface.
 """
 
 from deeplearning4j_tpu.nlp.tokenization import (
@@ -24,6 +26,11 @@ from deeplearning4j_tpu.nlp.tokenization import (
     DefaultTokenizerFactory,
     NGramTokenizerFactory,
     TokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.cjk import (
+    ChineseTokenizerFactory,
+    JapaneseTokenizerFactory,
+    KoreanTokenizerFactory,
 )
 from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabConstructor, VocabWord
 from deeplearning4j_tpu.nlp.sentenceiterator import (
@@ -53,6 +60,9 @@ __all__ = [
     "BagOfWordsVectorizer",
     "BaseTextVectorizer",
     "BasicLineIterator",
+    "ChineseTokenizerFactory",
+    "JapaneseTokenizerFactory",
+    "KoreanTokenizerFactory",
     "CollectionLabeledSentenceProvider",
     "CollectionSentenceIterator",
     "CnnSentenceDataSetIterator",

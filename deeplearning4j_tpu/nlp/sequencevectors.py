@@ -41,7 +41,8 @@ class SequenceVectors:
                  learning_rate: float = 0.025, min_learning_rate: float = 1e-4,
                  sampling: float = 0.0, epochs: int = 1, iterations: int = 1,
                  batch_size: int = 2048, min_word_frequency: int = 1,
-                 use_cbow: bool = False, seed: int = 12345):
+                 use_cbow: bool = False, seed: int = 12345,
+                 device_corpus: Optional[bool] = None):
         self.layer_size = layer_size
         self.window_size = window_size
         self.negative = negative
@@ -56,6 +57,9 @@ class SequenceVectors:
         self.min_word_frequency = min_word_frequency
         self.use_cbow = use_cbow
         self.seed = seed
+        # None = auto: corpus-resident device training for plain SGNS
+        # skip-gram when the corpus is big enough to matter (see fit())
+        self.device_corpus = device_corpus
 
         self.vocab: Optional[AbstractCache] = None
         self.syn0: Optional[np.ndarray] = None
@@ -93,6 +97,28 @@ class SequenceVectors:
             idx = [widx[t] for t in tokens if t in widx]
             if len(idx) >= 2:
                 yield np.asarray(idx, np.int64)
+
+    def _index_flat(self, sequences: Iterable[List[str]], widx=None):
+        """Vectorized (flat, sid) indexing for the device-corpus path: one
+        C-level pass instead of a per-sentence python list build (the
+        per-token loop was ~40% of the device path's host budget)."""
+        import itertools
+        if widx is None:
+            widx = {vw.word: vw.index for vw in self.vocab.vocab_words()}
+        seqs = [s if isinstance(s, list) else list(s) for s in sequences]
+        lens = np.fromiter((len(s) for s in seqs), np.int64, count=len(seqs))
+        flat = np.fromiter(
+            map(widx.get, itertools.chain.from_iterable(seqs),
+                itertools.repeat(-1)),
+            np.int64, count=int(lens.sum()))
+        sid = np.repeat(np.arange(len(seqs), dtype=np.int64), lens)
+        ok = flat >= 0  # drop OOV
+        flat, sid = flat[ok], sid[ok]
+        # drop sentences left with < 2 tokens (matches _index_sequences)
+        counts = np.bincount(sid, minlength=len(seqs))
+        good = counts[sid] >= 2
+        flat, sid = flat[good], sid[good]
+        return flat, sid
 
     def _subsample(self, flat, sid):
         """Frequent-word subsampling (word2vec formula; reference
@@ -273,14 +299,49 @@ class SequenceVectors:
             losses.append(l)
         return losses
 
+    # below this corpus size the host enumeration path wins (device pair
+    # sampling needs enough batches to cover the corpus; tiny test corpora
+    # also keep the exact reference enumeration semantics)
+    _DEVICE_CORPUS_MIN_TOKENS = 50_000
+
     def fit(self, sequences, chunk_sentences: int = 512):
         """Train (reference SequenceVectors.fit :192). ``sequences`` is a
-        factory (callable or re-iterable) of token-list iterables."""
+        factory (callable or re-iterable) of token-list iterables.
+
+        Plain SGNS skip-gram on a large corpus takes the corpus-resident
+        device path (kernels.sgns_corpus_macro_step): the encoded corpus
+        ships to HBM once and pair/negative generation happens on-device,
+        so throughput no longer scales with host->device bandwidth.
+        ``device_corpus=True/False`` forces/disables it."""
         seq_factory = sequences if callable(sequences) else (lambda: sequences)
         if self.vocab is None:
             self.build_vocab(seq_factory())
         if self.syn0 is None:
             self._init_tables()
+        dev_capable = (self.negative > 0 and not self.use_cbow
+                       and not self.use_hs)
+        if self.device_corpus and not dev_capable:
+            raise ValueError(
+                "device_corpus=True supports plain SGNS skip-gram only "
+                "(negative > 0, no CBOW, no hierarchical softmax); this "
+                f"config has negative={self.negative}, "
+                f"use_cbow={self.use_cbow}, use_hs={self.use_hs}")
+        # auto mode additionally requires sampling == 0: the device kernel
+        # approximates subsampling by dropping pairs per-endpoint rather
+        # than removing words from the stream (windows do not reach across
+        # dropped words) — close in expectation but not the reference
+        # semantics, so it must be opted into explicitly
+        use_dev = (self.device_corpus if self.device_corpus is not None
+                   else (dev_capable and self.sampling == 0))
+        if use_dev:
+            token_lists = [t for t in seq_factory()]
+            n_tokens = sum(len(t) for t in token_lists)
+            if (self.device_corpus
+                    or n_tokens >= self._DEVICE_CORPUS_MIN_TOKENS):
+                return self._fit_device_corpus(token_lists)
+            # below the gate: reuse the already-tokenized lists on the
+            # host path instead of re-running the tokenizer per epoch
+            seq_factory = (lambda lists=token_lists: lists)
         total = self.vocab.total_word_occurrences * self.epochs * self.iterations
         for epoch in range(self.epochs):
             epoch_losses: List = []
@@ -301,6 +362,124 @@ class SequenceVectors:
                 flat_losses = jnp.concatenate(
                     [jnp.atleast_1d(l) for l in epoch_losses])
                 self.loss_history.append(float(jnp.mean(flat_losses)))
+        return self
+
+    # segment size (tokens) for the device-corpus path: one segment = ONE
+    # async macro dispatch, so host indexing of segment i+1 overlaps device
+    # training of segment i; whole sentences per segment keep window
+    # semantics exact (windows never cross sentence boundaries anyway)
+    _DEVICE_CORPUS_SEG_TOKENS = 98_304
+
+    def _segment_token_lists(self, token_lists):
+        """Greedy whole-sentence packing, never exceeding the budget (so
+        every full segment compiles the SAME macro program; only the
+        leftover tail adds one more variant)."""
+        budget = self._DEVICE_CORPUS_SEG_TOKENS
+        seg, n = [], 0
+        for t in token_lists:
+            if seg and n + len(t) > budget:
+                yield seg
+                seg, n = [], 0
+            seg.append(t)
+            n += len(t)
+        if seg:
+            yield seg
+
+    def _fit_device_corpus(self, token_lists):
+        """Corpus-resident training (see fit()): per segment of whole
+        sentences, upload the encoded indices once (content-hash cached
+        across epochs AND across fits on the same corpus) and run ONE
+        jitted macro dispatch that generates pairs and negatives on device.
+
+        Pair quota per segment: T*(window+1) sampled pairs — the exact
+        expected pair count of the reference's dynamic-window enumeration
+        (per position 2*E[r] = window+1 pairs), drawn from the same joint
+        (position, side, offset) distribution by the kernel. Dispatches are
+        async; the only host sync is the per-epoch loss aggregation, so
+        host-side indexing of the next segment overlaps device training of
+        the current one."""
+        import hashlib
+
+        import jax
+        import jax.numpy as jnp
+
+        if self._neg_table_dev is None:
+            self._neg_table_dev = jnp.asarray(
+                self._neg_table.astype(np.int32))
+        if self._jax_key is None:
+            self._jax_key = jax.random.key(self.seed)
+        keep = None
+        if self.sampling:
+            counts = np.array([vw.count for vw in self.vocab.vocab_words()],
+                              np.float64)
+            freq = counts / counts.sum()
+            t = self.sampling
+            keep = jnp.asarray(np.minimum(
+                1.0, np.sqrt(t / freq) + t / freq).astype(np.float32))
+        # int16 halves tunnel upload when the index ranges allow
+        cdt = np.int16 if self.syn0.shape[0] < 2 ** 15 else np.int32
+        B = self.batch_size
+        W = self.window_size
+        total_expected = (self.vocab.total_word_occurrences * self.epochs
+                          * self.iterations)
+        cache = getattr(self, "_corpus_dev_cache", None)
+        if cache is None:
+            # insertion-ordered, FIFO-bounded: long-lived processes fitting
+            # many distinct corpora must not pin HBM forever
+            cache = self._corpus_dev_cache = {}
+        widx = {vw.word: vw.index for vw in self.vocab.vocab_words()}
+
+        def first_pass_plan():
+            """Index + upload segments lazily, so the caller's dispatch of
+            segment i overlaps (async) with indexing of segment i+1.
+            Boundaries (sid) are part of the cache identity."""
+            for seg in self._segment_token_lists(token_lists):
+                flat, sid = self._index_flat(seg, widx)
+                if len(flat) < 2:
+                    continue
+                flat = flat.astype(cdt)
+                sdt = (np.int16 if sid[-1] < 2 ** 15 else np.int32)
+                sid = sid.astype(sdt)
+                T = len(flat)
+                h = hashlib.sha1(flat.tobytes())
+                h.update(sid.tobytes())
+                hit = cache.get(h.digest())
+                if hit is None:
+                    hit = (jnp.asarray(flat), jnp.asarray(sid))
+                    while len(cache) >= 1024:  # FIFO bound on pinned HBM
+                        cache.pop(next(iter(cache)))
+                    cache[h.digest()] = hit
+                # full segments share one compiled program: quota from the
+                # BUDGET, not the exact T (overshoot < 1 sentence)
+                q = (self._DEVICE_CORPUS_SEG_TOKENS
+                     if T * 10 >= self._DEVICE_CORPUS_SEG_TOKENS * 9 else T)
+                nb = max(1, -(-(q * (W + 1)) // B))
+                yield hit[0], hit[1], T, nb
+
+        plan = None  # filled on the first pass; later passes reuse it
+        for _epoch in range(self.epochs):
+            epoch_losses = []
+            for _ in range(self.iterations):
+                entries = first_pass_plan() if plan is None else plan
+                built = [] if plan is None else None
+                for corpus_dev, sid_dev, T, nb in entries:
+                    lr = self._lr(total_expected)
+                    step = kernels.sgns_corpus_macro_step(
+                        self.negative, W, B, nb)
+                    self._jax_key, k = jax.random.split(self._jax_key)
+                    self.syn0, self.syn1, losses = step(
+                        self.syn0, self.syn1, corpus_dev, sid_dev,
+                        self._neg_table_dev, keep, k, np.float32(lr))
+                    epoch_losses.append(losses)
+                    self.words_processed += T
+                    if built is not None:
+                        built.append((corpus_dev, sid_dev, T, nb))
+                if built is not None:
+                    plan = built
+            if epoch_losses:
+                self.loss_history.append(float(jnp.mean(
+                    jnp.concatenate([jnp.atleast_1d(l)
+                                     for l in epoch_losses]))))
         return self
 
     def _fit_chunk(self, chunk, total_expected, epoch_losses):

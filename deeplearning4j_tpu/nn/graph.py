@@ -26,6 +26,7 @@ from deeplearning4j_tpu.nn.conf.graph import (
 )
 from deeplearning4j_tpu.nn.conf.layers import (Layer, apply_constraints,
                                                dropout_input, noisy_params)
+from deeplearning4j_tpu.optimize.fused_update import bucketed_apply
 from deeplearning4j_tpu.optimize.updaters import gradient_normalization
 
 
@@ -46,9 +47,11 @@ class ComputationGraph:
                              if isinstance(self.vertices[n][0], Layer)]
         self._txs = {}
         self._gnorms = {}
+        self._updaters = {}
         for n in self._layer_names:
             layer = self.vertices[n][0]
             upd = getattr(layer, "updater", None) or conf.updater
+            self._updaters[n] = upd
             self._txs[n] = upd.to_optax()
             self._gnorms[n] = gradient_normalization(
                 getattr(layer, "gradient_normalization", None),
@@ -349,12 +352,18 @@ class ComputationGraph:
         Per-vertex update chains are kept (vs one whole-tree optax
         transform, measured r4: no step-time difference on ResNet50) —
         they preserve wrapper-layer constraints, tensor-parallel opt-state
-        placement, and checkpoint compatibility."""
+        placement, and checkpoint compatibility. Small leaves additionally
+        run through ``bucketed_apply`` (optimize/fused_update.py), which
+        computes the identical math over one concatenated vector per
+        updater config so XLA emits a handful of fusions instead of one
+        per leaf (ResNet50: 244 small fusions ~8 ms/step)."""
+        results = bucketed_apply(self._layer_names, self._updaters,
+                                 self._txs, self._gnorms, params, grads,
+                                 opt_state)
         new_params = dict(params)
         new_opt = dict(opt_state)
         for n in self._layer_names:
-            g = self._gnorms[n](grads[n])
-            updates, os = self._txs[n].update(g, opt_state[n], params[n])
+            updates, os = results[n]
             new_params[n] = apply_constraints(
                 self.vertices[n][0], optax.apply_updates(params[n], updates))
             new_opt[n] = os

@@ -27,6 +27,7 @@ from deeplearning4j_tpu.nn.activations import get_activation
 from deeplearning4j_tpu.nn.conf.layers import (apply_constraints,
                                                dropout_input, noisy_params)
 from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+from deeplearning4j_tpu.optimize.fused_update import bucketed_apply
 from deeplearning4j_tpu.optimize.updaters import gradient_normalization
 import optax
 
@@ -50,10 +51,12 @@ class MultiLayerNetwork:
         # Every layer gets its updater — a layer whose init() returns an empty
         # param dict makes the transform a no-op, and layers with
         # non-regularizable trainables (e.g. batchnorm gamma/beta) still train.
-        self._txs = [
-            (l.updater if getattr(l, "updater", None) is not None else conf.updater).to_optax()
+        self._updaters = [
+            l.updater if getattr(l, "updater", None) is not None
+            else conf.updater
             for l in self.layers
         ]
+        self._txs = [u.to_optax() for u in self._updaters]
         # whether each layer's OUTPUT still has a time axis the feature mask
         # applies to; a per-step mask must not survive layers that collapse
         # time (cnn/ff) or it breaks the loss shape (graph.py does the same)
@@ -210,12 +213,16 @@ class MultiLayerNetwork:
 
     def _apply_updates(self, params, grads, opt_state):
         """Per-layer optimizer application shared by the standard, fused and
-        tBPTT steps."""
+        tBPTT steps. Small leaves are horizontally fused across layers via
+        ``bucketed_apply`` (optimize/fused_update.py) — identical math, one
+        XLA fusion per updater config instead of one per leaf."""
+        results = bucketed_apply(range(len(self._txs)), self._updaters,
+                                 self._txs, self._gnorms, params, grads,
+                                 opt_state)
         new_params = []
         new_opt = []
-        for i, tx in enumerate(self._txs):
-            g = self._gnorms[i](grads[i])
-            updates, os = tx.update(g, opt_state[i], params[i])
+        for i in range(len(self._txs)):
+            updates, os = results[i]
             new_params.append(apply_constraints(
                 self.layers[i], optax.apply_updates(params[i], updates)))
             new_opt.append(os)
@@ -312,19 +319,26 @@ class MultiLayerNetwork:
             xs = jnp.stack([jnp.asarray(d.features) for d in datasets])
             ys = jnp.stack([jnp.asarray(d.labels) for d in datasets])
             n_steps = len(datasets)
-            if any(d.features_mask is not None or d.labels_mask is not None
-                   for d in datasets):
-                ones = lambda d, m, like: (np.ones(like, np.float32)
-                                           if m is None else np.asarray(m))
-                fmasks = jnp.stack([
-                    jnp.asarray(ones(d, d.features_mask,
-                                     d.features.shape[:2]))
-                    for d in datasets])
-                lmasks = jnp.stack([
-                    jnp.asarray(ones(d, d.labels_mask, d.labels.shape[:2]))
-                    for d in datasets])
+            # Mixed mask presence across the group: fill the gaps with
+            # all-ones masks of the SAME shape the carried masks have (a
+            # fabricated features.shape[:2] mask is only meaningful for
+            # (batch, T, ...) sequence features, not 2-D/4-D inputs).
+            def _stack_masks(masks):
+                present = [np.asarray(m) for m in masks if m is not None]
+                if not present:
+                    return None
+                shape = present[0].shape
+                if any(p.shape != shape for p in present):
+                    raise ValueError(
+                        "fit_fused requires identical mask shapes across the "
+                        f"group; got {sorted({p.shape for p in present})}")
+                return jnp.stack([
+                    jnp.asarray(np.ones(shape, np.float32) if m is None
+                                else np.asarray(m)) for m in masks])
+            fmasks = _stack_masks([d.features_mask for d in datasets])
+            lmasks = _stack_masks([d.labels_mask for d in datasets])
         step_masked, step_nomask = self._get_jitted("train_fused")
-        if fmasks is not None:
+        if fmasks is not None or lmasks is not None:
             self.params, self.state, self.opt_state, self._rng, losses = \
                 step_masked(self.params, self.state, self.opt_state,
                             self._rng, xs, ys, fmasks, lmasks)
@@ -366,14 +380,8 @@ class MultiLayerNetwork:
         def step(params, state, opt_state, carries, rng, x, y, fmask, lmask):
             (loss, (new_state, new_carries)), grads = value_and_grad(
                 params, state, carries, x, y, rng, fmask, lmask)
-            new_params = []
-            new_opt = []
-            for i, tx in enumerate(self._txs):
-                g = self._gnorms[i](grads[i])
-                updates, os = tx.update(g, opt_state[i], params[i])
-                new_params.append(apply_constraints(
-                    self.layers[i], optax.apply_updates(params[i], updates)))
-                new_opt.append(os)
+            new_params, new_opt = self._apply_updates(params, grads,
+                                                      opt_state)
             return new_params, new_state, new_opt, new_carries, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))

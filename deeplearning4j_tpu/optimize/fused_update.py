@@ -1,0 +1,255 @@
+"""Bucketed ("horizontally fused") optimizer updates for small parameters.
+
+Parity surface: reference UpdaterBlock.java:104 /
+BaseMultiLayerUpdater.java:38 — the reference VIEW-flattens all parameters
+sharing an updater config into one contiguous buffer precisely so the
+updater runs as one vectorized op. This module is the XLA-era equivalent:
+TPU XLA emits one fusion per independent per-leaf optimizer chain (ResNet50:
+244 fusions, ~8 ms/step — each a ~30 us dispatch over a few KB), and has no
+horizontal-fusion pass to merge them. We therefore concatenate the raveled
+small leaves per (updater-config, dtype) bucket, run the update math ONCE
+over the flat vector, and slice the results back.
+
+Design constraints honored (the round-4 whole-tree-optax rewrite was
+rejected for breaking these):
+  * stored opt-state keeps the per-vertex optax structure — checkpoints,
+    tensor-parallel placement rules and wrapper-layer handling are
+    unchanged. The flat math reads/writes the SAME leaves; the per-vertex
+    ``tx.update`` call still advances scalar counts, and its (now unused)
+    small-leaf arithmetic is dead-code-eliminated by XLA.
+  * per-layer updater overrides and gradient-normalization still apply:
+    buckets are keyed by the frozen updater dataclass (field equality), and
+    grads are normalized per-layer BEFORE bucketing.
+  * layers whose optimizer state diverged (e.g. greedy layerwise pretrain
+    advanced some counts) stay exact: the flat math uses a PER-ELEMENT
+    count vector broadcast from each member's own scalar count.
+
+The flat update formulas mirror optax 0.2.x exactly (see
+``tests/test_fused_update.py`` for the step-by-step parity check against
+the stock per-vertex path).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.optimize.updaters import (
+    AdaDelta, AdaGrad, AdaMax, Adam, Nadam, Nesterovs, RmsProp, Sgd,
+)
+
+# updater class -> number of param-shaped accumulator trees its optax state
+# carries, in tree_flatten order (Adam: [mu, nu]; AdaDelta: [e_g, e_x]; ...)
+_N_ACCS = {Sgd: 0, Nesterovs: 1, Adam: 2, Nadam: 2, AdaMax: 2,
+           AdaGrad: 1, RmsProp: 1, AdaDelta: 2}
+
+DEFAULT_THRESHOLD = 1 << 16  # leaves with <= this many elements are bucketed
+
+
+def _classify_state(state, p_leaves):
+    """Split a per-vertex optax state into scalar leaves and accumulator
+    groups aligned with the vertex's param leaves.
+
+    Returns (state_leaves, state_treedef, scalar_idx, groups) where
+    ``groups[j]`` lists, for accumulator tree j, the index into
+    ``state_leaves`` of the leaf matching each param leaf (in param
+    tree_flatten order) — or None when the layout is not the expected
+    "scalars + k param-shaped trees" shape (caller falls back).
+    """
+    s_leaves, s_def = jax.tree_util.tree_flatten(state)
+    L = len(p_leaves)
+    if L == 0:
+        return None
+    scalar_idx, run = [], []
+    for i, s in enumerate(s_leaves):
+        if getattr(s, "ndim", None) == 0:
+            scalar_idx.append(i)
+        else:
+            run.append(i)
+    if len(run) % L:
+        return None
+    groups = [run[j * L:(j + 1) * L] for j in range(len(run) // L)]
+    for grp in groups:
+        for si, p in zip(grp, p_leaves):
+            if tuple(s_leaves[si].shape) != tuple(p.shape):
+                return None
+    return s_leaves, s_def, scalar_idx, groups
+
+
+def _lr_vec(u, cnt):
+    """Learning rate as used by optax's scale_by_learning_rate: evaluated at
+    the PRE-increment count for schedules, constant otherwise."""
+    lr = u._lr()
+    if callable(lr):
+        return lr(cnt)
+    return lr
+
+
+def _flat_update(u, g, p, accs, cnt):
+    """One optimizer step over flat 1-D arrays. ``cnt`` is the per-element
+    pre-increment step count (int32). Returns (update, new_accs)."""
+    f32 = jnp.float32
+    ci = (cnt + 1).astype(f32)
+    if isinstance(u, Sgd):
+        return -_lr_vec(u, cnt) * g, []
+    if isinstance(u, Nesterovs):
+        (tr,) = accs
+        tr2 = g + u.momentum * tr
+        return -_lr_vec(u, cnt) * (g + u.momentum * tr2), [tr2]
+    if isinstance(u, Nadam):
+        mu, nu = accs
+        mu2 = u.beta1 * mu + (1 - u.beta1) * g
+        nu2 = u.beta2 * nu + (1 - u.beta2) * g * g
+        mu_hat = (u.beta1 * (mu2 / (1 - u.beta1 ** (ci + 1)))
+                  + (1 - u.beta1) * (g / (1 - u.beta1 ** ci)))
+        nu_hat = nu2 / (1 - u.beta2 ** ci)
+        upd = mu_hat / (jnp.sqrt(nu_hat) + u.epsilon)
+        return -_lr_vec(u, cnt) * upd, [mu2, nu2]
+    if isinstance(u, AdaMax):
+        mu, nu = accs
+        mu2 = u.beta1 * mu + (1 - u.beta1) * g
+        nu2 = jnp.maximum(jnp.abs(g) + u.epsilon, u.beta2 * nu)
+        mu_hat = mu2 / (1 - u.beta1 ** ci)
+        return -_lr_vec(u, cnt) * (mu_hat / nu2), [mu2, nu2]
+    if isinstance(u, Adam):
+        mu, nu = accs
+        mu2 = u.beta1 * mu + (1 - u.beta1) * g
+        nu2 = u.beta2 * nu + (1 - u.beta2) * g * g
+        mu_hat = mu2 / (1 - u.beta1 ** ci)
+        nu_hat = nu2 / (1 - u.beta2 ** ci)
+        upd = mu_hat / (jnp.sqrt(nu_hat) + u.epsilon)
+        return -_lr_vec(u, cnt) * upd, [mu2, nu2]
+    if isinstance(u, AdaGrad):
+        (sos,) = accs
+        sos2 = sos + g * g
+        inv = jnp.where(sos2 > 0, jax.lax.rsqrt(sos2 + u.epsilon), 0.0)
+        return -_lr_vec(u, cnt) * g * inv, [sos2]
+    if isinstance(u, RmsProp):
+        (nu,) = accs
+        nu2 = u.rms_decay * nu + (1 - u.rms_decay) * g * g
+        return -_lr_vec(u, cnt) * g * jax.lax.rsqrt(nu2 + u.epsilon), [nu2]
+    if isinstance(u, AdaDelta):
+        eg, ex = accs
+        eg2 = u.rho * eg + (1 - u.rho) * g * g
+        delta = jnp.sqrt(ex + u.epsilon) / jnp.sqrt(eg2 + u.epsilon) * g
+        ex2 = u.rho * ex + (1 - u.rho) * delta * delta
+        return -delta, [eg2, ex2]
+    raise NotImplementedError(type(u).__name__)
+
+
+def _needs_count(u):
+    return isinstance(u, (Adam, Nadam, AdaMax)) or callable(u._lr())
+
+
+class _Member:
+    __slots__ = ("key", "leaf_i", "size", "shape")
+
+    def __init__(self, key, leaf_i, size, shape):
+        self.key, self.leaf_i = key, leaf_i
+        self.size, self.shape = size, shape
+
+
+def bucketed_apply(keys: Sequence, updaters: Dict, txs: Dict, gnorms: Dict,
+                   params: Dict, grads: Dict, opt_state: Dict,
+                   threshold: int = DEFAULT_THRESHOLD):
+    """Compute optimizer updates for every vertex/layer in ``keys``.
+
+    ``updaters[k]`` is the frozen Updater config, ``txs[k]`` its optax
+    transformation, ``gnorms[k]`` the per-layer gradient-normalization fn.
+    Returns ``{k: (updates_tree, new_opt_state)}``; the caller applies
+    constraints and ``optax.apply_updates`` per vertex exactly as before.
+
+    Leaves with more than ``threshold`` elements, unsupported updater
+    classes, and state layouts we do not recognize all take the stock
+    per-vertex path (correct, just not horizontally fused).
+    """
+    normed = {k: gnorms[k](grads[k]) for k in keys}
+    per_vertex = {}
+    for k in keys:
+        upd, new_os = txs[k].update(normed[k], opt_state[k], params[k])
+        per_vertex[k] = [upd, new_os]
+
+    # ---- plan buckets (trace-time python; shapes are static)
+    buckets: Dict[Tuple, List[_Member]] = {}
+    vertex_info = {}
+    for k in keys:
+        u = updaters[k]
+        n_accs = _N_ACCS.get(type(u))
+        if n_accs is None:
+            continue
+        p_leaves, p_def = jax.tree_util.tree_flatten(params[k])
+        if not p_leaves:
+            continue
+        cls = _classify_state(opt_state[k], p_leaves)
+        if cls is None or len(cls[3]) != n_accs:
+            continue
+        s_leaves, s_def, scalar_idx, groups = cls
+        if _needs_count(u) and not scalar_idx:
+            continue
+        cnt = s_leaves[scalar_idx[0]] if scalar_idx else None
+        g_leaves = jax.tree_util.tree_flatten(normed[k])[0]
+        if len(g_leaves) != len(p_leaves):
+            continue
+        vertex_info[k] = (p_leaves, p_def, g_leaves, groups, s_leaves, cnt)
+        for i, p in enumerate(p_leaves):
+            # rank<=1 only: conv/dense KERNELS must stay in the per-vertex
+            # path so their optimizer math keeps riding the dW-conv fusions
+            # (measured: bucketing them re-partitions the conv fusions and
+            # gives the time straight back)
+            if p.size <= threshold and p.ndim <= 1:
+                # repr-keyed: frozen-dataclass equality, and hashable even
+                # when a config carries a dict field (lr_schedule)
+                bkey = (repr(u), str(p.dtype))
+                buckets.setdefault(bkey, (u, []))[1].append(
+                    _Member(k, i, int(p.size), p.shape))
+
+    # ---- run each bucket's flat update and scatter results back
+    for u, members in buckets.values():
+        if len(members) < 2:
+            continue
+        def leaves_of(m, what, j=None):
+            pl, _, gl, groups, sl, cnt = vertex_info[m.key]
+            if what == "p":
+                return pl[m.leaf_i]
+            if what == "g":
+                return gl[m.leaf_i]
+            return sl[groups[j][m.leaf_i]]
+        flat_p = jnp.concatenate([leaves_of(m, "p").ravel() for m in members])
+        flat_g = jnp.concatenate([leaves_of(m, "g").ravel() for m in members])
+        n_accs = _N_ACCS[type(u)]
+        flat_accs = [jnp.concatenate([leaves_of(m, "s", j).ravel()
+                                      for m in members])
+                     for j in range(n_accs)]
+        if _needs_count(u):
+            flat_cnt = jnp.concatenate([
+                jnp.full((m.size,), vertex_info[m.key][5], jnp.int32)
+                for m in members])
+        else:
+            flat_cnt = jnp.zeros((), jnp.int32)  # unused
+        flat_upd, new_accs = _flat_update(u, flat_g, flat_p, flat_accs,
+                                          flat_cnt)
+        # scatter: overwrite the per-vertex updates and accumulator leaves so
+        # XLA dead-code-eliminates the per-leaf versions
+        ofs = 0
+        patch: Dict = {}
+        for m in members:
+            sl = slice(ofs, ofs + m.size)
+            patch.setdefault(m.key, []).append(
+                (m.leaf_i, flat_upd[sl].reshape(m.shape),
+                 [a[sl].reshape(m.shape) for a in new_accs]))
+            ofs += m.size
+        for k, entries in patch.items():
+            p_leaves, p_def, _, groups, _, _ = vertex_info[k]
+            upd_tree, new_os = per_vertex[k]
+            u_leaves, u_def = jax.tree_util.tree_flatten(upd_tree)
+            ns_leaves, ns_def = jax.tree_util.tree_flatten(new_os)
+            for leaf_i, new_u, accs in entries:
+                u_leaves[leaf_i] = new_u
+                for j, a in enumerate(accs):
+                    ns_leaves[groups[j][leaf_i]] = a
+            per_vertex[k] = [jax.tree_util.tree_unflatten(u_def, u_leaves),
+                             jax.tree_util.tree_unflatten(ns_def, ns_leaves)]
+
+    return {k: tuple(v) for k, v in per_vertex.items()}

@@ -173,7 +173,11 @@ class AdaDelta(Updater):
     epsilon: float = 1e-6
 
     def to_optax(self):
-        return optax.adadelta(rho=self.rho, eps=self.epsilon)
+        # learning_rate=1.0 (not None): DL4J's AdaDelta applies the raw
+        # delta as a DESCENT step; optax.adadelta(None) omits the final
+        # scale(-1) stage entirely and would ascend
+        return optax.adadelta(learning_rate=1.0, rho=self.rho,
+                              eps=self.epsilon)
 
 
 @register_updater

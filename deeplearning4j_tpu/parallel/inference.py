@@ -78,6 +78,10 @@ class ParallelInference:
         self._worker: Optional[threading.Thread] = None
         self._worker_lock = threading.Lock()
         self._stop = threading.Event()
+        # observables the worker has dequeued but not yet resolved; shutdown
+        # fails these too if the worker never comes back (wedged device call)
+        self._inflight: List[InferenceObservable] = []
+        self._inflight_lock = threading.Lock()
         # observability (exercised by the latency/throughput tests)
         self.requests_served = 0
         self.batches_dispatched = 0
@@ -133,6 +137,16 @@ class ParallelInference:
                 self._stop.set()
                 self._q.put(ParallelInference._SENTINEL)
                 w.join(timeout=10)
+                if w.is_alive():
+                    # worker is wedged (e.g. inside a device call): fail the
+                    # requests it already dequeued so their get() unblocks
+                    with self._inflight_lock:
+                        stuck, self._inflight = self._inflight, []
+                    for obs in stuck:
+                        if not obs.is_done():
+                            obs._fail(RuntimeError(
+                                "ParallelInference worker did not stop within "
+                                "10s at shutdown; in-flight request abandoned"))
             self._worker = None
             # fail anything the worker did not reach (its get() callers
             # would otherwise block forever)
@@ -188,6 +202,8 @@ class ParallelInference:
                 continue
             xs = [i[0] for i in items]
             sizes = [len(x) for x in xs]
+            with self._inflight_lock:
+                self._inflight = [obs for _, obs in items]
             try:
                 out = self.output(np.concatenate(xs, axis=0))
                 ofs = 0
@@ -197,6 +213,9 @@ class ParallelInference:
             except BaseException as e:
                 for _, obs in items:
                     obs._fail(e)
+            finally:
+                with self._inflight_lock:
+                    self._inflight = []
             self.requests_served += len(items)
             self.batches_dispatched += 1
             self.batch_sizes.append(len(items))

@@ -82,6 +82,38 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "train.session": "会话",
         "train.parameter": "参数",
     },
+    "ko": {
+        "train.pagetitle": "훈련 UI",
+        "train.nav.overview": "개요",
+        "train.nav.model": "모델",
+        "train.nav.system": "시스템",
+        "train.nav.tsne": "t-SNE",
+        "train.nav.activations": "활성화",
+        "train.overview.chart.score": "반복별 점수",
+        "train.overview.chart.ratio": "업데이트-파라미터 비율",
+        "train.overview.perftable.title": "성능",
+        "train.model.paramhist": "파라미터 히스토그램",
+        "train.model.updatehist": "업데이트 히스토그램",
+        "train.system.memory": "메모리",
+        "train.session": "세션",
+        "train.parameter": "파라미터",
+    },
+    "ru": {
+        "train.pagetitle": "Интерфейс обучения",
+        "train.nav.overview": "Обзор",
+        "train.nav.model": "Модель",
+        "train.nav.system": "Система",
+        "train.nav.tsne": "t-SNE",
+        "train.nav.activations": "Активации",
+        "train.overview.chart.score": "Оценка по итерациям",
+        "train.overview.chart.ratio": "Отношение обновления к параметру",
+        "train.overview.perftable.title": "Производительность",
+        "train.model.paramhist": "Гистограмма параметров",
+        "train.model.updatehist": "Гистограмма обновлений",
+        "train.system.memory": "Память",
+        "train.session": "Сессия",
+        "train.parameter": "Параметр",
+    },
 }
 
 FALLBACK_LANGUAGE = "en"

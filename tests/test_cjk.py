@@ -1,0 +1,114 @@
+"""CJK tokenizer tests (nlp/cjk.py): segmentation behavior per language plus
+a Word2Vec-trains smoke test on a tiny native-script two-topic corpus for
+each — mirroring the reference's nlp-chinese/japanese/korean test approach
+(tokenize, then train embeddings end-to-end)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp import Word2Vec
+from deeplearning4j_tpu.nlp.cjk import (
+    ChineseTokenizerFactory, JapaneseTokenizerFactory, KoreanTokenizerFactory,
+)
+
+
+def test_chinese_fmm_segmentation():
+    tf = ChineseTokenizerFactory()
+    toks = tf.create("我们喜欢机器学习").get_tokens()
+    assert "我们" in toks and "喜欢" in toks and "机器学习" in toks
+    # longest match wins: 机器学习 over 机器 + 学习
+    assert "机器" not in toks
+    # unknown chars fall back to single characters
+    toks2 = tf.create("犇犇").get_tokens()
+    assert toks2 == ["犇", "犇"]
+    # mixed latin survives
+    toks3 = tf.create("我们用GPU训练").get_tokens()
+    assert "GPU" in toks3 and "我们" in toks3
+
+
+def test_chinese_custom_lexicon():
+    tf = ChineseTokenizerFactory(lexicon=["犇犇"])
+    assert tf.create("犇犇").get_tokens() == ["犇犇"]
+
+
+def test_japanese_script_segmentation():
+    tf = JapaneseTokenizerFactory()
+    toks = tf.create("私はコーヒーを飲む").get_tokens()
+    # kanji run / particle / katakana (incl. long-vowel mark) / particle
+    assert "私" in toks and "は" in toks
+    assert "コーヒー" in toks
+    assert "を" in toks and "飲" in toks
+    toks2 = tf.create("データベースとネットワーク").get_tokens()
+    assert "データベース" in toks2 and "ネットワーク" in toks2 and "と" in toks2
+
+
+def test_korean_josa_stripping():
+    tf = KoreanTokenizerFactory()
+    toks = tf.create("학교에서 공부를 한다").get_tokens()
+    assert "학교" in toks and "에서" in toks
+    assert "공부" in toks and "를" in toks
+    assert "한다" in toks
+    # short words keep their particle (stem must be 2+ syllables)
+    assert tf.create("물을").get_tokens() == ["물을"]
+    # emit_josa=False drops the particles
+    toks3 = KoreanTokenizerFactory(emit_josa=False).create(
+        "학교에서 공부를").get_tokens()
+    assert toks3 == ["학교", "공부"]
+
+
+def _two_topic_sents(topic_a, topic_b, n=300, seed=7, joiner=" "):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        pool = topic_a if rng.random() < 0.5 else topic_b
+        words = rng.choice(pool, size=rng.integers(4, 9))
+        out.append(joiner.join(words))
+    return out
+
+
+def _intra_minus_inter(model, topic_a, topic_b):
+    def sim(a, b):
+        va, vb = model.word_vector(a), model.word_vector(b)
+        return float(np.dot(va, vb)
+                     / (np.linalg.norm(va) * np.linalg.norm(vb) + 1e-9))
+    intra = np.mean([sim(a, b) for a in topic_a for b in topic_a if a != b])
+    inter = np.mean([sim(a, b) for a in topic_a for b in topic_b])
+    return intra - inter
+
+
+def _smoke_train(tf, topic_a, topic_b, joiner):
+    sents = _two_topic_sents(topic_a, topic_b, joiner=joiner)
+    model = Word2Vec(tokenizer_factory=tf, layer_size=32, window_size=3,
+                     min_word_frequency=1, epochs=20,
+                     learning_rate=0.3, batch_size=512, seed=42)
+    model.fit(sents)
+    for w in topic_a + topic_b:
+        assert model.has_word(w), f"tokenizer lost word {w}"
+    assert _intra_minus_inter(model, topic_a, topic_b) > 0.15
+
+
+def test_word2vec_trains_on_chinese_corpus():
+    animals = ["猫", "狗", "马", "牛", "羊", "鸡"]
+    tech = ["电脑", "网络", "软件", "数据", "程序", "系统"]
+    _smoke_train(ChineseTokenizerFactory(), animals, tech, joiner="")
+
+
+def test_word2vec_trains_on_japanese_corpus():
+    drinks = ["コーヒー", "ビール", "ジュース", "ミルク", "ワイン", "ココア"]
+    vehicles = ["タクシー", "バス", "トラック", "フェリー", "ヘリ", "ボート"]
+    _smoke_train(JapaneseTokenizerFactory(), drinks, vehicles, joiner="と")
+
+
+def test_word2vec_trains_on_korean_corpus():
+    school = ["학교", "공부", "선생님", "숙제", "교실", "시험"]
+    food = ["김치", "비빔밥", "불고기", "냉면", "만두", "잡채"]
+    # attach josa to words so the tokenizer must strip them
+    sents = _two_topic_sents([w + "에서" for w in school],
+                             [w + "를" for w in food], joiner=" ")
+    model = Word2Vec(tokenizer_factory=KoreanTokenizerFactory(),
+                     layer_size=32, window_size=3,
+                     min_word_frequency=1, epochs=20, learning_rate=0.3,
+                     batch_size=512, seed=42)
+    model.fit(sents)
+    for w in school + food:
+        assert model.has_word(w), f"tokenizer lost word {w}"
+    assert _intra_minus_inter(model, school, food) > 0.15

@@ -315,3 +315,41 @@ def test_bfloat16_inference_path():
     ref.fit(ds)
     np.testing.assert_allclose(np.asarray(ref.output(ds.features)),
                                np.asarray(out), atol=0.05)
+
+
+def test_space_to_depth_stem_matches_direct_conv():
+    """The 7x7/s2 SAME stem rewrite (_space_to_depth_conv) must be exact
+    math vs lax.conv_general_dilated — fwd AND gradients — across odd/even
+    output parities and 1..4 input channels (ADVICE r4: the blocking/padding
+    derivation had no equivalence test)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def direct(x, w):
+        return lax.conv_general_dilated(
+            x, w, window_strides=(2, 2), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    rng = np.random.default_rng(7)
+    for h, w_, c in [(14, 14, 3), (16, 12, 1), (12, 18, 4), (10, 10, 2)]:
+        x = jnp.asarray(rng.standard_normal((2, h, w_, c), np.float32))
+        k = jnp.asarray(rng.standard_normal((7, 7, c, 5), np.float32) * 0.1)
+        lay = ConvolutionLayer(n_out=5, kernel_size=(7, 7), stride=(2, 2),
+                               convolution_mode="same")
+        assert lay._space_to_depth_eligible(x)
+        got = ConvolutionLayer._space_to_depth_conv(x, k)
+        want = direct(x, k)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        # gradients wrt input and kernel through an arbitrary scalar loss
+        co = jnp.asarray(rng.standard_normal(want.shape, np.float32))
+        gx, gk = jax.grad(
+            lambda a, b: jnp.sum(ConvolutionLayer._space_to_depth_conv(a, b) * co),
+            argnums=(0, 1))(x, k)
+        rx, rk = jax.grad(
+            lambda a, b: jnp.sum(direct(a, b) * co), argnums=(0, 1))(x, k)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(rk),
+                                   rtol=2e-4, atol=2e-4)

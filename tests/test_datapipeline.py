@@ -386,3 +386,75 @@ def test_moving_window_matrix():
     np.testing.assert_array_equal(wr[1], np.rot90(wr[0], 1))
     with pytest.raises(ValueError):
         MovingWindowMatrix(a, 5, 2)
+
+
+# ---------------------------------------------------------------- streaming
+def test_streaming_iterator_trains_from_producer_thread():
+    """An external producer pushes batches while fit() consumes — the
+    dl4j-streaming capability (CamelKafkaRouteBuilder.java:1) without the
+    Kafka fabric."""
+    import threading
+    from deeplearning4j_tpu.datasets.streaming import StreamingDataSetIterator
+    from deeplearning4j_tpu.nn.conf import (
+        InputType, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize.updaters import Adam
+
+    rng = np.random.default_rng(0)
+    it = StreamingDataSetIterator(queue_size=4)
+
+    def produce():
+        for _ in range(12):
+            x = rng.standard_normal((16, 8)).astype(np.float32)
+            y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+            it.push(x, y)
+        it.end()
+
+    t = threading.Thread(target=produce)
+    t.start()
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(it)
+    t.join()
+    assert it.consumed == 12 and it.pushed == 12
+    assert np.isfinite(net.score())
+    # a second segment streams through the same iterator
+    t2 = threading.Thread(target=lambda: (it.push(
+        rng.standard_normal((16, 8)).astype(np.float32),
+        np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]), it.end()))
+    t2.start()
+    net.fit(it)
+    t2.join()
+    assert it.consumed == 13
+
+
+def test_streaming_http_receiver():
+    import io
+    import urllib.request
+    from deeplearning4j_tpu.datasets.streaming import (
+        StreamingDataSetIterator, StreamingHttpReceiver,
+    )
+    it = StreamingDataSetIterator()
+    recv = StreamingHttpReceiver(it)
+    try:
+        buf = io.BytesIO()
+        np.savez(buf, features=np.ones((4, 3), np.float32),
+                 labels=np.zeros((4, 2), np.float32))
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{recv.port}/push", data=buf.getvalue(),
+            method="POST")
+        assert urllib.request.urlopen(req).status == 200
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{recv.port}/end", data=b"", method="POST"))
+        batches = list(it)
+        assert len(batches) == 1
+        assert batches[0].features.shape == (4, 3)
+        assert batches[0].labels.shape == (4, 2)
+    finally:
+        recv.stop()

@@ -1,0 +1,177 @@
+"""Parity tests for the bucketed ("horizontally fused") optimizer path
+(optimize/fused_update.py): the flat concatenated-vector math must match the
+stock per-vertex optax chains step for step, for every supported updater,
+including lr schedules, per-layer overrides, and post-pretrain count skew.
+Reference surface: UpdaterBlock.java:104 (the reference's own view-flattened
+updater buffers)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.optimize.fused_update import bucketed_apply
+from deeplearning4j_tpu.optimize.updaters import (
+    AdaDelta, AdaGrad, AdaMax, Adam, Nadam, Nesterovs, NoOp, RmsProp, Sgd,
+    gradient_normalization,
+)
+
+UPDATERS = [
+    Sgd(learning_rate=0.05),
+    Sgd(learning_rate=0.05, lr_policy="step", lr_decay_rate=0.5,
+        lr_policy_steps=3),
+    Nesterovs(learning_rate=0.05, momentum=0.9),
+    Adam(learning_rate=0.01),
+    AdaMax(learning_rate=0.01),
+    Nadam(learning_rate=0.01),
+    AdaGrad(learning_rate=0.05),
+    RmsProp(learning_rate=0.01),
+    AdaDelta(),
+]
+
+
+def _setup(updater, n_vertices=4, seed=0):
+    rng = np.random.default_rng(seed)
+    keys = [f"v{i}" for i in range(n_vertices)]
+    updaters = {k: updater for k in keys}
+    txs = {k: updater.to_optax() for k in keys}
+    gnorms = {k: gradient_normalization(None) for k in keys}
+    params = {
+        k: {"W": jnp.asarray(rng.standard_normal((5, 3), np.float32)),
+            "b": jnp.asarray(rng.standard_normal((3,), np.float32))}
+        for k in keys}
+    opt = {k: txs[k].init(params[k]) for k in keys}
+    return keys, updaters, txs, gnorms, params, opt, rng
+
+
+def _reference_step(keys, txs, gnorms, params, grads, opt):
+    import optax
+    new_p, new_o = {}, {}
+    for k in keys:
+        g = gnorms[k](grads[k])
+        upd, os = txs[k].update(g, opt[k], params[k])
+        new_p[k] = optax.apply_updates(params[k], upd)
+        new_o[k] = os
+    return new_p, new_o
+
+
+@pytest.mark.parametrize("updater", UPDATERS,
+                         ids=lambda u: type(u).__name__ + (u.lr_policy or ""))
+def test_flat_math_matches_optax(updater):
+    import optax
+    keys, updaters, txs, gnorms, params, opt, rng = _setup(updater)
+    params_ref = jax.tree_util.tree_map(jnp.array, params)
+    opt_ref = jax.tree_util.tree_map(jnp.array, opt)
+    for step in range(7):
+        grads = {
+            k: {"W": jnp.asarray(rng.standard_normal((5, 3), np.float32)),
+                "b": jnp.asarray(rng.standard_normal((3,), np.float32))}
+            for k in keys}
+        results = bucketed_apply(keys, updaters, txs, gnorms, params, grads,
+                                 opt)
+        for k in keys:
+            upd, opt[k] = results[k]
+            params[k] = optax.apply_updates(params[k], upd)
+        params_ref, opt_ref = _reference_step(keys, txs, gnorms, params_ref,
+                                              grads, opt_ref)
+        for k in keys:
+            for leaf, ref in zip(jax.tree_util.tree_leaves(params[k]),
+                                 jax.tree_util.tree_leaves(params_ref[k])):
+                np.testing.assert_allclose(
+                    np.asarray(leaf), np.asarray(ref), rtol=2e-6, atol=2e-7,
+                    err_msg=f"{type(updater).__name__} step {step} params {k}")
+            for leaf, ref in zip(jax.tree_util.tree_leaves(opt[k]),
+                                 jax.tree_util.tree_leaves(opt_ref[k])):
+                np.testing.assert_allclose(
+                    np.asarray(leaf), np.asarray(ref), rtol=2e-6, atol=2e-7,
+                    err_msg=f"{type(updater).__name__} step {step} opt {k}")
+
+
+def test_mixed_updaters_and_large_leaves():
+    """Per-layer updater overrides bucket separately; leaves above the
+    threshold take the stock path; NoOp layers stay frozen."""
+    import optax
+    rng = np.random.default_rng(1)
+    keys = ["a", "b", "c", "d"]
+    updaters = {"a": Adam(learning_rate=0.01), "b": Adam(learning_rate=0.01),
+                "c": Sgd(learning_rate=0.1), "d": NoOp()}
+    txs = {k: u.to_optax() for k, u in updaters.items()}
+    gnorms = {k: gradient_normalization("clipl2perlayer", 5.0) for k in keys}
+    params = {
+        "a": {"W": jnp.asarray(rng.standard_normal((4, 4), np.float32))},
+        # 70k elements: above DEFAULT_THRESHOLD -> per-vertex path
+        "b": {"W": jnp.asarray(rng.standard_normal((70000,), np.float32)),
+              "b": jnp.asarray(rng.standard_normal((7,), np.float32))},
+        "c": {"W": jnp.asarray(rng.standard_normal((3, 3), np.float32))},
+        "d": {"W": jnp.asarray(rng.standard_normal((3, 3), np.float32))},
+    }
+    opt = {k: txs[k].init(params[k]) for k in keys}
+    params_ref = jax.tree_util.tree_map(jnp.array, params)
+    opt_ref = jax.tree_util.tree_map(jnp.array, opt)
+    for _ in range(4):
+        grads = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(
+                rng.standard_normal(p.shape, np.float32)), params)
+        results = bucketed_apply(keys, updaters, txs, gnorms, params, grads,
+                                 opt)
+        for k in keys:
+            upd, opt[k] = results[k]
+            params[k] = optax.apply_updates(params[k], upd)
+        params_ref, opt_ref = _reference_step(keys, txs, gnorms, params_ref,
+                                              grads, opt_ref)
+    for k in keys:
+        np.testing.assert_allclose(
+            np.asarray(jax.tree_util.tree_leaves(params[k])[0]),
+            np.asarray(jax.tree_util.tree_leaves(params_ref[k])[0]),
+            rtol=2e-6, atol=2e-7)
+    np.testing.assert_allclose(np.asarray(params["d"]["W"]),
+                               np.asarray(params_ref["d"]["W"]))
+
+
+def test_count_skew_after_partial_stepping():
+    """Vertices whose counts diverged (greedy layerwise pretrain) still get
+    exact per-member bias correction from the per-element count vector."""
+    import optax
+    updater = Adam(learning_rate=0.01)
+    keys, updaters, txs, gnorms, params, opt, rng = _setup(updater)
+    # advance v0's count by stepping it alone 3 times
+    for _ in range(3):
+        g = {"W": jnp.ones((5, 3), jnp.float32) * 0.1,
+             "b": jnp.ones((3,), jnp.float32) * 0.1}
+        upd, opt["v0"] = txs["v0"].update(g, opt["v0"], params["v0"])
+        params["v0"] = optax.apply_updates(params["v0"], upd)
+    params_ref = jax.tree_util.tree_map(jnp.array, params)
+    opt_ref = jax.tree_util.tree_map(jnp.array, opt)
+    for _ in range(4):
+        grads = {
+            k: {"W": jnp.asarray(rng.standard_normal((5, 3), np.float32)),
+                "b": jnp.asarray(rng.standard_normal((3,), np.float32))}
+            for k in keys}
+        results = bucketed_apply(keys, updaters, txs, gnorms, params, grads,
+                                 opt)
+        for k in keys:
+            upd, opt[k] = results[k]
+            params[k] = optax.apply_updates(params[k], upd)
+        params_ref, opt_ref = _reference_step(keys, txs, gnorms, params_ref,
+                                              grads, opt_ref)
+    for k in keys:
+        for leaf, ref in zip(jax.tree_util.tree_leaves(params[k]),
+                             jax.tree_util.tree_leaves(params_ref[k])):
+            np.testing.assert_allclose(np.asarray(leaf), np.asarray(ref),
+                                       rtol=2e-6, atol=2e-7)
+
+
+def test_adadelta_descends():
+    """Regression: optax.adadelta(learning_rate=None) omits the final
+    scale(-1) — AdaDelta.to_optax must produce DESCENT updates."""
+    import optax
+    tx = AdaDelta().to_optax()
+    p = jnp.array([1.0, -1.0])
+    s = tx.init(p)
+    for _ in range(20):
+        g = 2 * p  # d/dp of p^2
+        upd, s = tx.update(g, s, p)
+        p = optax.apply_updates(p, upd)
+    assert float(jnp.sum(p * p)) < 2.0 - 1e-3

@@ -270,3 +270,80 @@ def test_tinyyolo_detection_trains():
     # box responsibility (argmax IoU) flips as boxes move, so descent is
     # non-monotone — require a solid overall reduction instead
     assert net.score() < 0.5 * s0, (s0, net.score())
+
+
+# ------------------------------------------------------------------- RBM
+def test_rbm_cd_gradient_is_free_energy_difference():
+    """The autodiff gradient of pretrain_loss must equal the classic CD-k
+    statistics: dL/dW = (vk^T p(h|vk) - v0^T p(h|v0)) / B with the SAME
+    Gibbs sample vk (reference RBM.java contrastiveDivergence gradient
+    assembly)."""
+    from deeplearning4j_tpu.nn.conf.pretrain import RBM
+    rbm = RBM(n_in=6, n_out=4, k=2)
+    params, _ = rbm.init(jax.random.key(0), InputType.feed_forward(6))
+    rng = np.random.default_rng(5)
+    x = jnp.asarray((rng.random((16, 6)) > 0.5).astype(np.float32))
+    key = jax.random.key(9)
+    g = jax.grad(lambda p: rbm.pretrain_loss(p, {}, x, key))(params)
+    vk = rbm.gibbs_chain(params, x, key)  # same key -> same chain
+    ph0 = jax.nn.sigmoid(x @ params["W"] + params["b"])
+    phk = jax.nn.sigmoid(vk @ params["W"] + params["b"])
+    B = x.shape[0]
+    expect_W = (jnp.asarray(vk).T @ phk - x.T @ ph0) / B
+    expect_b = jnp.mean(phk - ph0, 0)
+    expect_vb = jnp.mean(vk - x, 0)
+    np.testing.assert_allclose(np.asarray(g["W"]), np.asarray(expect_W),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g["b"]), np.asarray(expect_b),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g["vb"]), np.asarray(expect_vb),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_rbm_pretrain_learns_data_distribution():
+    """CD-1 pretraining on structured binary data must lower the data's
+    free energy relative to noise and shrink one-step reconstruction
+    error (the reference's RBM monitoring quantity)."""
+    from deeplearning4j_tpu.nn.conf.pretrain import RBM
+    rng = np.random.default_rng(11)
+    # two prototype patterns + bit noise
+    protos = np.array([[1, 1, 1, 1, 0, 0, 0, 0, 1, 1, 0, 0],
+                       [0, 0, 0, 0, 1, 1, 1, 1, 0, 0, 1, 1]], np.float32)
+    idx = rng.integers(0, 2, 512)
+    x = protos[idx]
+    flip = rng.random(x.shape) < 0.05
+    x = np.where(flip, 1 - x, x).astype(np.float32)
+    net = _net([RBM(n_out=8, k=1),
+                OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+               InputType.feed_forward(12), updater=Adam(5e-2))
+    rbm = net.layers[0]
+    key = jax.random.key(3)
+    re0 = float(rbm.reconstruction_error(net.params[0], jnp.asarray(x), key))
+    noise = jnp.asarray((rng.random((512, 12)) > 0.5).astype(np.float32))
+    net.pretrain_layer(0, DataSet(x, np.zeros((512, 2), np.float32)),
+                       num_epochs=60)
+    re1 = float(rbm.reconstruction_error(net.params[0], jnp.asarray(x), key))
+    assert re1 < re0 * 0.6, (re0, re1)
+    # data free energy must now sit clearly below random-noise free energy
+    fe_data = float(jnp.mean(rbm.free_energy(net.params[0], jnp.asarray(x))))
+    fe_noise = float(jnp.mean(rbm.free_energy(net.params[0], noise)))
+    assert fe_data < fe_noise - 1.0, (fe_data, fe_noise)
+    # supervised fine-tune end to end (forward = hidden activations)
+    y = np.eye(2, dtype=np.float32)[idx]
+    net.fit(DataSet(x, y), num_epochs=30)
+    assert net.output(x[:4]).shape == (4, 2)
+    assert net.score() < 0.5
+
+
+def test_rbm_config_round_trip():
+    from deeplearning4j_tpu.nn.conf.pretrain import RBM
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-2))
+            .list()
+            .layer(RBM(n_out=8, k=3, visible_unit="gaussian", sparsity=0.1))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(12)).build())
+    js = conf.to_json()
+    back = MultiLayerConfiguration.from_json(js)
+    l0 = back.layers[0]
+    assert type(l0).__name__ == "RBM"
+    assert l0.k == 3 and l0.visible_unit == "gaussian" and l0.sparsity == 0.1

@@ -258,3 +258,43 @@ def test_pallas_scatter_add():
     want2 = np.zeros((V, D), np.float32)
     np.add.at(want2, np.asarray(idx[:50]), np.asarray(grads[:50]))
     np.testing.assert_allclose(np.asarray(out2), want2, atol=1e-5)
+
+
+# ------------------------------------------------------- device-corpus path
+def test_word2vec_device_corpus_path_quality():
+    """The corpus-resident device path (on-device pair/negative generation,
+    shared-negative batches — kernels.sgns_corpus_macro_step) must reach
+    the same topical separation as the host enumeration path."""
+    sents, animals, tools = two_topic_corpus()
+    model = Word2Vec(device_corpus=True, **W2V_KW)
+    model.fit(sents)
+    assert model.vocab_size() == 12
+    intra, inter = intra_vs_inter(model, animals, tools)
+    assert intra > inter + 0.25, f"intra={intra:.3f} inter={inter:.3f}"
+    # loss tracked per epoch and generally decreasing
+    assert len(model.loss_history) == model.epochs
+    assert model.loss_history[-1] < model.loss_history[0]
+
+
+def test_word2vec_device_corpus_respects_sampling_and_multi_epoch():
+    sents, animals, tools = two_topic_corpus(n=120)
+    model = Word2Vec(device_corpus=True, sampling=1e-2,
+                     **dict(W2V_KW, epochs=4))
+    model.fit(sents)
+    assert len(model.loss_history) == 4
+    # subsampled training still trains every vocab word's vector
+    v0 = model.get_word_vector_matrix()
+    assert np.isfinite(v0).all()
+
+
+def test_word2vec_device_corpus_gate():
+    """Auto mode keeps tiny corpora on the exact host enumeration path;
+    device_corpus=False forces it off even for big ones."""
+    from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+    sents, _, _ = two_topic_corpus(n=20)
+    m = Word2Vec(**W2V_KW)
+    m.fit(sents)
+    assert not hasattr(m, "_corpus_dev_cache")  # host path ran
+    m2 = Word2Vec(device_corpus=True, **W2V_KW)
+    m2.fit(sents)
+    assert hasattr(m2, "_corpus_dev_cache")  # forced device path
