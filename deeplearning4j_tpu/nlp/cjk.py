@@ -51,14 +51,15 @@ _ZH_LEXICON = """
 医院 城市 农村 交通 汽车 飞机 火车 地铁 食物 水果 蔬菜 米饭 面条 咖啡 牛奶
 """.split()
 
-_CJK_RE = re.compile(r"[一-鿿㐀-䶿]")
+_CJK_RUN_RE = re.compile(r"[一-鿿㐀-䶿]+")
 _WORD_RE = re.compile(r"[A-Za-z0-9_]+|[^\sA-Za-z0-9_]")
 
 
 class ChineseTokenizerFactory(TokenizerFactory):
     """Forward-maximum-match segmentation (reference
-    ChineseTokenizerFactory.java surface). ``lexicon`` extends/replaces the
-    bundled word list; ``max_word_len`` caps the FMM window."""
+    ChineseTokenizerFactory.java surface). ``lexicon`` adds words to the
+    bundled list (``extend=False`` replaces it); the FMM window adapts to
+    the longest lexicon entry."""
 
     def __init__(self, lexicon: Optional[Iterable[str]] = None,
                  extend: bool = True):
@@ -87,7 +88,7 @@ class ChineseTokenizerFactory(TokenizerFactory):
         tokens: List[str] = []
         for chunk in text.split():
             i = 0
-            for m in re.finditer(r"[一-鿿㐀-䶿]+", chunk):
+            for m in _CJK_RUN_RE.finditer(chunk):
                 if m.start() > i:
                     tokens.extend(_WORD_RE.findall(chunk[i:m.start()]))
                 tokens.extend(self._segment_cjk(m.group()))

@@ -450,9 +450,11 @@ class SequenceVectors:
                         cache.pop(next(iter(cache)))
                     cache[h.digest()] = hit
                 # full segments share one compiled program: quota from the
-                # BUDGET, not the exact T (overshoot < 1 sentence)
-                q = (self._DEVICE_CORPUS_SEG_TOKENS
-                     if T * 10 >= self._DEVICE_CORPUS_SEG_TOKENS * 9 else T)
+                # BUDGET, not the exact T (overshoot < 1 sentence). A
+                # segment can only EXCEED the budget via one oversized
+                # sentence — its quota must stay T, never be clamped down
+                budget = self._DEVICE_CORPUS_SEG_TOKENS
+                q = budget if (T <= budget and T * 10 >= budget * 9) else T
                 nb = max(1, -(-(q * (W + 1)) // B))
                 yield hit[0], hit[1], T, nb
 

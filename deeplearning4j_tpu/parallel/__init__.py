@@ -5,6 +5,13 @@ from deeplearning4j_tpu.parallel.trainer import (  # noqa: F401
     ParallelWrapper,
 )
 from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401
+from deeplearning4j_tpu.parallel.sharding import (  # noqa: F401
+    ShardIterator,
+    shard_dataset_rows,
+    shard_directory,
+    shard_files,
+    shard_iterator,
+)
 from deeplearning4j_tpu.parallel.stats import TrainingStats  # noqa: F401
 from deeplearning4j_tpu.parallel.watchdog import (  # noqa: F401
     CollectiveTimeoutError, CollectiveWatchdog,

@@ -1,0 +1,125 @@
+"""Per-process input sharding helpers for multi-host training.
+
+Parity surface: the reference's data-side distribution story —
+``spark/util/SparkUtils.java:1`` (export/repartition so each executor reads
+its slice) and ``spark/data/*`` path-based RDD readers. Here the same two
+capabilities are host-process-indexed functions:
+
+* :func:`shard_iterator` — every process walks the SAME global
+  DataSetIterator and takes its own row-slice of each batch; feeding these
+  shards to :meth:`ClusterTrainer.fit_local_shard` (or just calling
+  ``ClusterTrainer.fit`` with the global iterator, which wraps this) trains
+  on exactly the global batch with zero duplication.
+* :func:`shard_files` — deterministic round-robin file assignment, the
+  export/read pattern for corpora too big to stream through every host.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+
+
+def _process_defaults(process_index, num_processes):
+    if process_index is None or num_processes is None:
+        import jax
+        process_index = jax.process_index() if process_index is None \
+            else process_index
+        num_processes = jax.process_count() if num_processes is None \
+            else num_processes
+    if not (0 <= process_index < num_processes):
+        raise ValueError(
+            f"process_index {process_index} out of range for "
+            f"{num_processes} processes")
+    return process_index, num_processes
+
+
+def shard_dataset_rows(ds: DataSet, process_index: Optional[int] = None,
+                       num_processes: Optional[int] = None) -> DataSet:
+    """This process's contiguous row-slice of a global batch. The global
+    batch size must divide the process count (static shapes are the TPU
+    contract — no ragged per-host shards)."""
+    pi, np_ = _process_defaults(process_index, num_processes)
+    n = ds.num_examples()
+    if n % np_:
+        raise ValueError(
+            f"Global batch {n} not divisible by {np_} processes")
+    k = n // np_
+    sl = slice(pi * k, (pi + 1) * k)
+
+    def cut(a):
+        return None if a is None else np.asarray(a)[sl]
+
+    return DataSet(cut(ds.features), cut(ds.labels),
+                   features_mask=cut(ds.features_mask),
+                   labels_mask=cut(ds.labels_mask))
+
+
+class ShardIterator(DataSetIterator):
+    """Re-iterable view of a global iterator yielding this process's row
+    shard of every batch (see module docstring)."""
+
+    def __init__(self, base, process_index: Optional[int] = None,
+                 num_processes: Optional[int] = None):
+        self._base = base
+        self._pi = process_index
+        self._np = num_processes
+
+    def reset(self):
+        if hasattr(self._base, "reset"):
+            self._base.reset()
+
+    def _generate(self):
+        for ds in self._base:
+            yield shard_dataset_rows(ds, self._pi, self._np)
+
+    def batch_size(self):
+        pi, np_ = _process_defaults(self._pi, self._np)
+        bs = self._base.batch_size() if hasattr(self._base, "batch_size") \
+            else 0
+        return bs // np_ if bs else 0
+
+    def input_columns(self):
+        return self._base.input_columns() \
+            if hasattr(self._base, "input_columns") else None
+
+    def total_outcomes(self):
+        return self._base.total_outcomes() \
+            if hasattr(self._base, "total_outcomes") else None
+
+
+def shard_iterator(iterator, process_index: Optional[int] = None,
+                   num_processes: Optional[int] = None) -> ShardIterator:
+    """Wrap a global DataSetIterator (or any iterable of DataSets) so this
+    process sees its own row shard of each global batch."""
+    return ShardIterator(iterator, process_index, num_processes)
+
+
+def shard_files(paths: Sequence[str], process_index: Optional[int] = None,
+                num_processes: Optional[int] = None,
+                sort: bool = True) -> List[str]:
+    """Deterministic round-robin assignment of files to this process
+    (reference SparkUtils export/repartition reading pattern). Sorting
+    first makes the assignment identical on every host regardless of
+    listing order."""
+    pi, np_ = _process_defaults(process_index, num_processes)
+    items = sorted(paths) if sort else list(paths)
+    return items[pi::np_]
+
+
+def shard_directory(path: str, pattern: str = "*",
+                    process_index: Optional[int] = None,
+                    num_processes: Optional[int] = None) -> List[str]:
+    """``shard_files`` over a directory glob."""
+    import glob as _glob
+    return shard_files(_glob.glob(os.path.join(path, pattern)),
+                       process_index, num_processes)
+
+
+__all__ = ["shard_dataset_rows", "shard_iterator", "ShardIterator",
+           "shard_files", "shard_directory"]

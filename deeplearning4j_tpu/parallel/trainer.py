@@ -259,6 +259,77 @@ class ClusterTrainer(ParallelWrapper):
                                    num_processes=num_processes,
                                    process_id=process_id)
 
+    # ---- multi-host batch assembly ----
+    def _assemble_global(self, ds: DataSet) -> DataSet:
+        """Build the global sharded batch from this process's LOCAL rows
+        (``jax.make_array_from_process_local_data``); single-process falls
+        back to a plain sharded device_put."""
+        def gput(a):
+            if a is None:
+                return None
+            arr = np.asarray(a)
+            sh = data_sharding(self.mesh, arr.ndim)
+            if jax.process_count() == 1:
+                return jax.device_put(jnp.asarray(arr), sh)
+            return jax.make_array_from_process_local_data(sh, arr)
+        return DataSet(gput(ds.features), gput(ds.labels),
+                       features_mask=gput(ds.features_mask),
+                       labels_mask=gput(ds.labels_mask))
+
+    # ParallelWrapper.fit_batch / EarlyStoppingParallelTrainer route here:
+    # in cluster mode the incoming DataSet is the process-LOCAL shard
+    def _shard_dataset(self, ds: DataSet) -> DataSet:
+        n_global = ds.num_examples() * jax.process_count()
+        dp = self.mesh.shape[DATA_AXIS]
+        if n_global % dp:
+            raise ValueError(
+                f"Global batch {n_global} (local {ds.num_examples()} x "
+                f"{jax.process_count()} processes) not divisible by "
+                f"data-parallel size {dp}")
+        return self._assemble_global(ds)
+
+    def fit_batch(self, ds: DataSet, drop_ragged: bool = False) -> bool:
+        self._place_params()
+        n_global = ds.num_examples() * jax.process_count()
+        if n_global % self.mesh.shape[DATA_AXIS] and drop_ragged:
+            if not self._warned_ragged:
+                log.warning(
+                    "Dropping ragged local batch of %d examples",
+                    ds.num_examples())
+                self._warned_ragged = True
+            return False
+        with self.mesh:
+            if self.stats is None:
+                self._model_fit_batch(self._shard_dataset(ds))
+            else:
+                with self.stats.time("data_placement"):
+                    sharded = self._shard_dataset(ds)
+                with self.stats.time("train_dispatch"):
+                    self._model_fit_batch(sharded)
+                self.stats.examples += ds.num_examples()
+                self.stats.minibatches += 1
+        return True
+
+    def fit(self, data, num_epochs: int = 1):
+        """Train from an ORDINARY global iterator: every process walks the
+        same iterator and this trainer internally takes the process's row
+        shard of each batch (parallel/sharding.py), so user code needs no
+        manual pre-sharding (reference SparkDl4jMultiLayer.fit(RDD)
+        ergonomics)."""
+        from deeplearning4j_tpu.parallel.sharding import shard_iterator
+        if isinstance(data, DataSet):
+            data = [data]
+        local = shard_iterator(data) if jax.process_count() > 1 else data
+        return self.fit_local_shard(local, num_epochs=num_epochs)
+
+    def score_local_shard(self, ds: DataSet) -> float:
+        """Loss over a validation batch given as per-process local rows
+        (the multi-host analogue of ``model.score_dataset``)."""
+        self._place_params()
+        with self.mesh:
+            g = self._assemble_global(ds)
+            return float(self.model.score_dataset(g))
+
     def fit_local_shard(self, data, num_epochs: int = 1,
                         collective_timeout_s: Optional[float] = None,
                         watchdog_every: int = 10):
@@ -279,22 +350,36 @@ class ClusterTrainer(ParallelWrapper):
         step_no = 0
         with self.mesh:
             for _ in range(num_epochs):
+                for listener in self.model.listeners:
+                    listener.on_epoch_start(self.model)
+                seen = 0
                 for ds in data:
-                    def gput(a):
-                        if a is None:
-                            return None
-                        arr = np.asarray(a)
-                        sh = data_sharding(self.mesh, arr.ndim)
-                        if jax.process_count() == 1:
-                            return jax.device_put(jnp.asarray(arr), sh)
-                        return jax.make_array_from_process_local_data(sh, arr)
-                    self.model.fit(DataSet(gput(ds.features), gput(ds.labels),
-                                           gput(ds.features_mask),
-                                           gput(ds.labels_mask)))
+                    # _model_fit_batch, not model.fit: per-epoch hooks and
+                    # the epoch counter must fire once per EPOCH, not once
+                    # per minibatch (same contract as ParallelWrapper.fit)
+                    def one_step(d=ds):
+                        self._model_fit_batch(self._shard_dataset(d))
+                    if wd is None:
+                        one_step()
+                    else:
+                        # the dispatch itself can block synchronously on a
+                        # dead peer's collective rendezvous, so the deadline
+                        # must wrap the whole call, not just a later sync
+                        wd.call(one_step,
+                                what=f"cluster step {step_no + 1} dispatch")
                     step_no += 1
+                    seen += 1
                     if wd is not None and step_no % max(1, watchdog_every) == 0:
                         wd.sync(self.model.params,
                                 what=f"cluster step {step_no}")
+                if seen == 0:
+                    raise ValueError(
+                        "No batches this epoch — the data iterable is empty "
+                        "or a one-shot generator exhausted by a previous "
+                        "epoch; pass a re-iterable DataSetIterator")
+                for listener in self.model.listeners:
+                    listener.on_epoch_end(self.model)
+                self.model.epoch += 1
             if wd is not None:
                 # tail steps after the last every-N sync must not escape the
                 # deadline — a hang there would otherwise surface only at
@@ -318,11 +403,29 @@ class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
 
     def __init__(self, config, model, train_data, validation_data=None,
                  score_calculator=None, mesh: Optional[Mesh] = None,
-                 tensor_parallel: bool = False):
+                 tensor_parallel: bool = False, cluster: bool = False):
+        """``cluster=True`` routes batches through a ClusterTrainer (multi-
+        host assembly of per-process local shards) and, when no explicit
+        score_calculator is given, scores validation data through the same
+        multi-host path (local rows per process, global loss)."""
+        trainer_holder = []
+        if cluster and score_calculator is None and validation_data is not None:
+            def score_calculator(m):
+                total, n = 0.0, 0
+                for ds in validation_data:
+                    total += (trainer_holder[0].score_local_shard(ds)
+                              * ds.num_examples())
+                    n += ds.num_examples()
+                return total / max(n, 1)
         super().__init__(config, model, train_data, validation_data,
                          score_calculator)
-        self.wrapper = ParallelWrapper(model, mesh=mesh,
-                                       tensor_parallel=tensor_parallel)
+        if cluster:
+            self.wrapper = ClusterTrainer(model, mesh=mesh,
+                                          tensor_parallel=tensor_parallel)
+            trainer_holder.append(self.wrapper)
+        else:
+            self.wrapper = ParallelWrapper(model, mesh=mesh,
+                                           tensor_parallel=tensor_parallel)
 
     def _fit_batch(self, ds) -> bool:
         # per-batch path: no epoch-listener double fire, ragged tails dropped

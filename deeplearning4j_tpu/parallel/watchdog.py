@@ -103,6 +103,35 @@ class CollectiveWatchdog:
             raise err[0]
         return tree
 
+    # ------------------------------------------------------------------ call
+    def call(self, fn: Callable, what: str = "guarded call"):
+        """Run a blocking callable under the deadline on a worker thread —
+        needed when the HANG can occur inside the dispatch itself (a
+        cross-process execute can block synchronously waiting for a dead
+        peer's collective rendezvous, so a post-hoc ``sync`` would never be
+        reached). Returns fn's result; raises CollectiveTimeoutError (or
+        aborts) on deadline. The wedged worker thread cannot be cancelled —
+        deployments that must free the chip use ``abort=True``."""
+        done = threading.Event()
+        out: dict = {}
+
+        def run():
+            try:
+                out["v"] = fn()
+            except BaseException as e:  # surfaced on the caller thread
+                out["e"] = e
+            finally:
+                done.set()
+
+        t0 = time.monotonic()
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        if not done.wait(self.timeout_s):
+            self._expire(what, time.monotonic() - t0)
+        if "e" in out:
+            raise out["e"]
+        return out.get("v")
+
     # --------------------------------------------------------------- guard()
     class _Guard:
         def __init__(self, wd: "CollectiveWatchdog", what: str):

@@ -1,15 +1,25 @@
-"""Worker process for the 2-process ClusterTrainer parity test.
+"""Worker process for the 2-process ClusterTrainer tests.
 
-Run as: python multihost_worker.py <rank> <port> <out_dir>
-Each process owns 4 virtual CPU devices; the mesh spans the 8 global devices
-and each rank feeds its half of the fixed global batch. Rank 0 writes the
-final parameters for the parent test to compare against single-process
-training (ParameterAveragingTrainingMaster.java:308 exact-averaging
-semantics).
+Run as: python multihost_worker.py <mode> <rank> <port> <out_dir>
+Each process owns 4 virtual CPU devices; the mesh spans the 8 global devices.
+
+Modes (parent test = tests/test_multihost.py):
+  mln_sgd    — MLN + SGD via ClusterTrainer.fit (ordinary global iterator,
+               internal per-process row sharding); rank 0 writes params for
+               the single-process parity comparison.
+  graph_adam — ComputationGraph + Adam (optimizer state replicated across
+               processes) via fit_local_shard; rank 0 writes params.
+  earlystop  — EarlyStoppingParallelTrainer(cluster=True): trains with
+               per-process shards, scores validation through the multi-host
+               path, writes the result summary.
+  watchdog   — rank 1 stops participating (sleeps) after the first step;
+               rank 0's CollectiveWatchdog must raise CollectiveTimeoutError
+               with its diagnostic instead of hanging forever.
 """
 
 import os
 import sys
+import time
 
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=4")
@@ -21,17 +31,58 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 
 
+def _conf(seed=17, updater=None):
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    return (NeuralNetConfiguration.builder()
+            .seed(seed).updater(updater or Sgd(learning_rate=0.05))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+
+
+def _graph_conf():
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.conf.graph import GraphBuilder
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.network import Builder as NNBuilder
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    parent = NNBuilder()
+    parent.seed(23).updater(Adam(learning_rate=0.02)).weight_init("xavier")
+    return (GraphBuilder(parent)
+            .add_inputs("in")
+            .add_layer("h", DenseLayer(n_out=16, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_out=3, loss="mcxent"), "h")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+
+
+def _iris_global():
+    from deeplearning4j_tpu.datasets import IrisDataSetIterator
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    full = next(iter(IrisDataSetIterator(batch=150)))
+    return DataSet(full.features[:144], full.labels[:144])
+
+
+def _flat_params(params):
+    import jax as _j
+    flat, _ = _j.tree_util.tree_flatten_with_path(params)
+    return {_j.tree_util.keystr(path): np.asarray(v) for path, v in flat}
+
+
 def main():
-    rank, port, out_dir = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    mode, rank, port, out_dir = (sys.argv[1], int(sys.argv[2]),
+                                 int(sys.argv[3]), sys.argv[4])
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     sys.path.insert(0, repo)
 
-    from deeplearning4j_tpu.datasets import IrisDataSetIterator
     from deeplearning4j_tpu.datasets.dataset import DataSet
-    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
-    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-    from deeplearning4j_tpu.optimize.updaters import Sgd
     from deeplearning4j_tpu.parallel import ClusterTrainer
 
     ClusterTrainer.initialize(coordinator_address=f"localhost:{port}",
@@ -39,26 +90,91 @@ def main():
     assert jax.device_count() == 8, jax.device_count()
     assert jax.local_device_count() == 4
 
-    conf = (NeuralNetConfiguration.builder()
-            .seed(17).updater(Sgd(learning_rate=0.05)).weight_init("xavier")
-            .list()
-            .layer(DenseLayer(n_out=16, activation="tanh"))
-            .layer(OutputLayer(n_out=3, loss="mcxent"))
-            .set_input_type(InputType.feed_forward(4))
-            .build())
-    net = MultiLayerNetwork(conf).init()
-    ct = ClusterTrainer(net)  # mesh over all 8 global devices
-
-    full = next(iter(IrisDataSetIterator(batch=150)))
+    ds = _iris_global()
     half = 144 // 2
     lo = rank * half
-    local = DataSet(full.features[lo:lo + half], full.labels[lo:lo + half])
-    ct.fit_local_shard(local, num_epochs=5)
+    local = DataSet(ds.features[lo:lo + half], ds.labels[lo:lo + half])
 
-    if rank == 0:
-        flat = {f"{i}_{k}": np.asarray(v)
-                for i, p in enumerate(net.params) for k, v in p.items()}
-        np.savez(os.path.join(out_dir, "rank0_params.npz"), **flat)
+    if mode == "mln_sgd":
+        net = MultiLayerNetwork(_conf()).init()
+        ct = ClusterTrainer(net)
+        # ordinary GLOBAL iterator: ct.fit shards rows per process itself
+        ct.fit([ds], num_epochs=5)
+        if rank == 0:
+            np.savez(os.path.join(out_dir, "rank0_params.npz"),
+                     **_flat_params(net.params))
+
+    elif mode == "graph_adam":
+        net = ComputationGraph(_graph_conf()).init()
+        ct = ClusterTrainer(net)
+        ct.fit_local_shard(local, num_epochs=5)
+        if rank == 0:
+            np.savez(os.path.join(out_dir, "rank0_params.npz"),
+                     **_flat_params(net.params))
+
+    elif mode == "earlystop":
+        from deeplearning4j_tpu.earlystopping.conditions import (
+            MaxEpochsTerminationCondition, ScoreImprovementEpochTerminationCondition,
+        )
+        from deeplearning4j_tpu.earlystopping.trainer import (
+            EarlyStoppingConfiguration,
+        )
+        from deeplearning4j_tpu.parallel import EarlyStoppingParallelTrainer
+        net = MultiLayerNetwork(_conf()).init()
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[
+                MaxEpochsTerminationCondition(6),
+                ScoreImprovementEpochTerminationCondition(3)])
+        est = EarlyStoppingParallelTrainer(
+            cfg, net, train_data=[local], validation_data=[local],
+            cluster=True)
+        result = est.fit()
+        if rank == 0:
+            with open(os.path.join(out_dir, "earlystop.txt"), "w") as f:
+                f.write(f"{result.termination_reason}\n"
+                        f"{result.total_epochs}\n"
+                        f"{result.best_model_score}\n")
+        assert result.total_epochs <= 6
+        assert np.isfinite(result.best_model_score)
+
+    elif mode == "watchdog":
+        from deeplearning4j_tpu.parallel.watchdog import CollectiveTimeoutError
+        net = MultiLayerNetwork(_conf()).init()
+        ct = ClusterTrainer(net)
+        # one healthy joint step so everything is compiled and placed
+        ct.fit_local_shard(local, num_epochs=1,
+                           collective_timeout_s=120)
+        if rank == 1:
+            # simulate a dead/partitioned peer: stop participating. Poll for
+            # rank 0's verdict, then exit (bounded by the parent timeout).
+            flag = os.path.join(out_dir, "wd-fired.txt")
+            for _ in range(240):
+                if os.path.exists(flag):
+                    break
+                time.sleep(0.5)
+            # skip atexit: jax.distributed finalization would block on the
+            # (by now gone) rank-0 coordinator
+            print("rank1-done", flush=True)
+            os._exit(0)
+        else:
+            try:
+                ct.fit_local_shard(local, num_epochs=1,
+                                   collective_timeout_s=6,
+                                   watchdog_every=1)
+                raise AssertionError("watchdog did not fire")
+            except CollectiveTimeoutError as e:
+                msg = str(e)
+                assert "did not complete within" in msg and "process 0/2" in msg, msg
+                with open(os.path.join(out_dir, "wd-fired.txt"), "w") as f:
+                    f.write(msg)
+            # the runtime still holds the wedged collective: normal
+            # interpreter exit would hang syncing it (this is exactly why
+            # production uses abort=True). Hard-exit after reporting.
+            print("rank0-done", flush=True)
+            os._exit(0)
+    else:
+        raise SystemExit(f"unknown mode {mode}")
+
     print(f"rank{rank}-done", flush=True)
 
 

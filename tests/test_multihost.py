@@ -1,11 +1,16 @@
-"""2-process multi-host ClusterTrainer parity test.
+"""2-process multi-host ClusterTrainer tests.
 
-Launches two real OS processes, each owning 4 virtual CPU devices, joined via
-jax.distributed into one 8-device mesh (Gloo collectives over localhost —
-the DCN stand-in). Verifies the multi-host
-``jax.make_array_from_process_local_data`` path produces the SAME parameters
-as single-process training on the same global batch — the reference's
-ParameterAveragingTrainingMaster.java:308 exact-averaging contract.
+Each test launches two real OS processes, each owning 4 virtual CPU devices,
+joined via jax.distributed into one 8-device mesh (Gloo collectives over
+localhost — the DCN stand-in). Coverage (VERDICT r4 #3 + reference suites
+TestEarlyStoppingSpark.java:1, spark/util/SparkUtils.java:1):
+
+* MLN + SGD parity vs single-process (through ClusterTrainer.fit on an
+  ORDINARY global iterator — internal per-process row sharding)
+* ComputationGraph + Adam parity (optimizer state across processes)
+* EarlyStoppingParallelTrainer(cluster=True) end to end
+* CollectiveWatchdog actually fires when a peer stops participating
+* shard_iterator / shard_files helpers (in-process)
 """
 
 import os
@@ -18,11 +23,9 @@ import pytest
 
 from deeplearning4j_tpu.datasets import IrisDataSetIterator
 from deeplearning4j_tpu.datasets.dataset import DataSet
-from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
-from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
-from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-from deeplearning4j_tpu.optimize.updaters import Sgd
-from deeplearning4j_tpu.parallel import ClusterTrainer
+from deeplearning4j_tpu.parallel.sharding import (
+    shard_dataset_rows, shard_files, shard_iterator,
+)
 
 _WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
 
@@ -33,48 +36,128 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _reference_params():
-    """Single-process training, identical seed/global batch/epochs."""
-    conf = (NeuralNetConfiguration.builder()
-            .seed(17).updater(Sgd(learning_rate=0.05)).weight_init("xavier")
-            .list()
-            .layer(DenseLayer(n_out=16, activation="tanh"))
-            .layer(OutputLayer(n_out=3, loss="mcxent"))
-            .set_input_type(InputType.feed_forward(4))
-            .build())
-    net = MultiLayerNetwork(conf).init()
-    ct = ClusterTrainer(net)
-    full = next(iter(IrisDataSetIterator(batch=150)))
-    ds = DataSet(full.features[:144], full.labels[:144])
-    ct.fit_local_shard(ds, num_epochs=5)
-    return {f"{i}_{k}": np.asarray(v)
-            for i, p in enumerate(net.params) for k, v in p.items()}
-
-
-def test_two_process_cluster_matches_single_process(tmp_path, devices):
-    # worker wall-clock is bounded by the communicate(timeout=420) below
+def _run_workers(mode, tmp_path, timeout=420, require_ranks=(0, 1)):
+    """``require_ranks``: ranks whose clean exit the test depends on (the
+    watchdog drill expects rank 1 to be force-terminated by the JAX
+    distributed client once the rank-0 coordinator exits — exactly what a
+    real cluster does on coordinator death)."""
     port = _free_port()
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     env["JAX_PLATFORMS"] = "cpu"
     procs = [subprocess.Popen(
-        [sys.executable, _WORKER, str(rank), str(port), str(tmp_path)],
+        [sys.executable, _WORKER, mode, str(rank), str(port), str(tmp_path)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
         for rank in (0, 1)]
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=420)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
-        pytest.fail("multihost workers timed out:\n" + "\n".join(outs))
+        pytest.fail(f"multihost workers ({mode}) timed out:\n"
+                    + "\n".join(outs))
     for rank, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank{rank} failed:\n{out[-3000:]}"
-        assert f"rank{rank}-done" in out
+        if rank in require_ranks:
+            assert p.returncode == 0, f"{mode} rank{rank} failed:\n{out[-3000:]}"
+            assert f"rank{rank}-done" in out
+    return outs
+
+
+def _single_process_params(conf_fn, is_graph, epochs=5):
+    """Single-process training on the same seed/global batch."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("mh_worker", _WORKER)
+    w = importlib.util.module_from_spec(spec)
+    sys.modules["mh_worker"] = w
+    spec.loader.exec_module(w)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = getattr(w, conf_fn)()
+    net = (ComputationGraph(conf) if is_graph
+           else MultiLayerNetwork(conf)).init()
+    ds = w._iris_global()
+    net.fit(ds, num_epochs=epochs)
+    return w._flat_params(net.params)
+
+
+def test_two_process_mln_sgd_matches_single_process(tmp_path, devices):
+    _run_workers("mln_sgd", tmp_path)
     got = dict(np.load(tmp_path / "rank0_params.npz"))
-    want = _reference_params()
+    want = _single_process_params("_conf", is_graph=False)
     assert set(got) == set(want)
     for k in want:
         np.testing.assert_allclose(got[k], want[k], atol=1e-5,
                                    err_msg=f"param {k} diverged")
+
+
+def test_two_process_graph_adam_matches_single_process(tmp_path, devices):
+    """ComputationGraph with Adam: moments/counts live replicated across
+    BOTH processes and must advance identically to single-process."""
+    _run_workers("graph_adam", tmp_path)
+    got = dict(np.load(tmp_path / "rank0_params.npz"))
+    want = _single_process_params("_graph_conf", is_graph=True)
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], atol=1e-4,
+                                   err_msg=f"param {k} diverged")
+
+
+def test_two_process_early_stopping(tmp_path, devices):
+    _run_workers("earlystop", tmp_path)
+    lines = (tmp_path / "earlystop.txt").read_text().splitlines()
+    reason, total_epochs, best = lines[0], int(lines[1]), float(lines[2])
+    assert reason == "epoch_condition"
+    assert 1 <= total_epochs <= 6
+    assert np.isfinite(best)
+
+
+def test_watchdog_fires_on_dead_peer(tmp_path, devices):
+    """Kill-one-worker drill: rank 1 stops participating after step 1; rank
+    0's fit_local_shard(collective_timeout_s=6) must raise
+    CollectiveTimeoutError with the process/device diagnostic rather than
+    blocking forever on the orphaned all-reduce. Rank 1 may be terminated
+    by the distributed client on coordinator death — only rank 0's clean
+    verdict matters."""
+    _run_workers("watchdog", tmp_path, timeout=300, require_ranks=(0,))
+    msg = (tmp_path / "wd-fired.txt").read_text()
+    assert "did not complete within" in msg
+    assert "process 0/2" in msg
+
+
+# ---------------------------------------------------------- shard helpers
+def test_shard_iterator_partitions_rows():
+    it = IrisDataSetIterator(batch=50)
+    s0 = list(shard_iterator(it, 0, 2))
+    s1 = list(shard_iterator(it, 1, 2))
+    full = list(IrisDataSetIterator(batch=50))
+    assert len(s0) == len(s1) == len(full)
+    for a, b, f in zip(s0, s1, full):
+        assert a.num_examples() == b.num_examples() == f.num_examples() // 2
+        np.testing.assert_array_equal(
+            np.concatenate([a.features, b.features]), f.features)
+    # re-iterable (reset propagates to the base iterator)
+    again = shard_iterator(IrisDataSetIterator(batch=50), 0, 2)
+    assert len(list(again)) == len(list(again))
+
+
+def test_shard_dataset_rows_validates():
+    ds = DataSet(np.zeros((10, 3), np.float32), np.zeros((10, 2), np.float32))
+    with pytest.raises(ValueError, match="not divisible"):
+        shard_dataset_rows(ds, 0, 3)
+    half = shard_dataset_rows(ds, 1, 2)
+    assert half.num_examples() == 5
+
+
+def test_shard_files_round_robin():
+    paths = [f"/data/part-{i:03d}.csv" for i in range(7)]
+    a = shard_files(paths, 0, 2)
+    b = shard_files(paths, 1, 2)
+    assert sorted(a + b) == sorted(paths)
+    assert not set(a) & set(b)
+    # deterministic under shuffled listing order
+    import random
+    shuffled = paths[:]
+    random.Random(3).shuffle(shuffled)
+    assert shard_files(shuffled, 0, 2) == a
