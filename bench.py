@@ -207,10 +207,23 @@ def bench_resnet50():
     # the actual graph in _model_fwd_flops_per_image. MFU denominator is
     # configurable (chip generations differ); default 197e12 = v5e bf16 peak.
     peak = float(os.environ.get("BENCH_PEAK_FLOPS", "197e12"))
-    note = ("r4: FLOPs now computed from the graph (2 FLOPs/MAC, ~7.7e9 fwd "
-            "per img); earlier rounds used 4.1e9 (the MAC count) and thus "
-            "under-reported MFU ~1.88x. Sync methodology unchanged since r3 "
-            "(value-fetch; r2 numbers were pipeline-inflated).")
+    notes = {
+        "float32": (
+            "fp32 ablation (tools/PROFILE_r5.md): 'default' matmul "
+            "precision already lowers f32 convs to single bf16 MXU passes "
+            "(forcing true-f32 multi-pass costs a further 1.6x); the "
+            "deficit vs bf16 is doubled HBM bytes per activation crossing "
+            "in a bandwidth-bound step — bf16 compute with f32 master "
+            "weights is the measured-optimal mode."),
+        "bfloat16": (
+            "step sits within ~5% of the measured bandwidth floor: conv "
+            "fwd+dW+dX alone = 29.2 ms (51.4% MFU ceiling); the ~16 ms "
+            "non-conv remainder is BN-train stats/normalize/residual + BN "
+            "backward re-reads, ~4.7 full activation-set HBM crossings "
+            "(tools/PROFILE_r5.md) — practical cap ~0.33 MFU on this XLA "
+            "build. FLOPs computed from the graph, 2 FLOPs/MAC; value-"
+            "fetch sync."),
+    }
     # fp32 secondary line first; bf16 (the TPU-idiomatic compute dtype) is
     # the headline and prints LAST
     for dtype, metric in (
@@ -223,7 +236,7 @@ def bench_resnet50():
              dtype=dtype, achieved_tflops=round(achieved / 1e12, 2),
              mfu=round(achieved / peak, 4),
              fwd_gflops_per_img=round(fwd_flops / 1e9, 2),
-             note=note + " " + _REPS_NOTE)
+             note=notes[dtype] + " " + _REPS_NOTE)
 
 
 def bench_graveslstm():
@@ -264,7 +277,7 @@ def bench_graveslstm():
     dt = _best_of(timed)
     emit("graveslstm_charrnn_train_chars_per_sec_per_chip",
          groups * batch * seq_len / dt, "chars/sec", "charlstm",
-         note="r4: fit_tbptt_fused (all windows of a batch scan-fused into "
+         note="fit_tbptt_fused (all windows of a batch scan-fused into "
               "one dispatch, exact per-window tBPTT math). " + _REPS_NOTE)
 
 

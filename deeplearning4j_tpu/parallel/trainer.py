@@ -159,6 +159,12 @@ class ParallelWrapper:
                 m.listeners = saved_listeners
                 m.epoch = epoch0
 
+    def _is_ragged(self, ds: DataSet) -> bool:
+        """Whether this batch cannot shard evenly. Overridden by
+        ClusterTrainer with a PROCESS-LOCAL predicate so every host reaches
+        the same drop/train decision without a coordination collective."""
+        return bool(ds.num_examples() % self.mesh.shape[DATA_AXIS])
+
     def fit_batch(self, ds: DataSet, drop_ragged: bool = False) -> bool:
         """Train on ONE global batch (sharded over the mesh); returns whether
         the batch was trained. ``drop_ragged`` drops batches that don't
@@ -166,7 +172,7 @@ class ParallelWrapper:
         the TPU contract, so a ragged tail is dropped, not recompiled."""
         self._place_params()
         dp = self.mesh.shape[DATA_AXIS]
-        if ds.num_examples() % dp and drop_ragged:
+        if self._is_ragged(ds) and drop_ragged:
             if not self._warned_ragged:
                 log.warning(
                     "Dropping ragged batch of %d examples (global batch must "
@@ -288,27 +294,17 @@ class ClusterTrainer(ParallelWrapper):
                 f"data-parallel size {dp}")
         return self._assemble_global(ds)
 
-    def fit_batch(self, ds: DataSet, drop_ragged: bool = False) -> bool:
-        self._place_params()
-        n_global = ds.num_examples() * jax.process_count()
-        if n_global % self.mesh.shape[DATA_AXIS] and drop_ragged:
-            if not self._warned_ragged:
-                log.warning(
-                    "Dropping ragged local batch of %d examples",
-                    ds.num_examples())
-                self._warned_ragged = True
-            return False
-        with self.mesh:
-            if self.stats is None:
-                self._model_fit_batch(self._shard_dataset(ds))
-            else:
-                with self.stats.time("data_placement"):
-                    sharded = self._shard_dataset(ds)
-                with self.stats.time("train_dispatch"):
-                    self._model_fit_batch(sharded)
-                self.stats.examples += ds.num_examples()
-                self.stats.minibatches += 1
-        return True
+    def _is_ragged(self, ds: DataSet) -> bool:
+        """PROCESS-LOCAL ragged predicate: local rows vs this host's share
+        of the data axis. Every host must feed the same local batch size
+        (shard_iterator guarantees it) — with equal shards this decision is
+        identical on all hosts, so no host can drop a batch its peers train
+        (which would orphan their collective and hang them). Unequal local
+        shards are a user error and fail loudly in
+        jax.make_array_from_process_local_data rather than hanging."""
+        local_share = max(1, self.mesh.shape[DATA_AXIS]
+                          // max(1, jax.process_count()))
+        return bool(ds.num_examples() % local_share)
 
     def fit(self, data, num_epochs: int = 1):
         """Train from an ORDINARY global iterator: every process walks the
@@ -324,11 +320,12 @@ class ClusterTrainer(ParallelWrapper):
 
     def score_local_shard(self, ds: DataSet) -> float:
         """Loss over a validation batch given as per-process local rows
-        (the multi-host analogue of ``model.score_dataset``)."""
+        (the multi-host analogue of ``model.score_dataset``). Goes through
+        ``_shard_dataset`` so a ragged validation batch raises the same
+        clear divisibility error as the training path."""
         self._place_params()
         with self.mesh:
-            g = self._assemble_global(ds)
-            return float(self.model.score_dataset(g))
+            return float(self.model.score_dataset(self._shard_dataset(ds)))
 
     def fit_local_shard(self, data, num_epochs: int = 1,
                         collective_timeout_s: Optional[float] = None,
@@ -358,7 +355,15 @@ class ClusterTrainer(ParallelWrapper):
                     # the epoch counter must fire once per EPOCH, not once
                     # per minibatch (same contract as ParallelWrapper.fit)
                     def one_step(d=ds):
-                        self._model_fit_batch(self._shard_dataset(d))
+                        if self.stats is None:
+                            self._model_fit_batch(self._shard_dataset(d))
+                        else:
+                            with self.stats.time("data_placement"):
+                                sharded = self._shard_dataset(d)
+                            with self.stats.time("train_dispatch"):
+                                self._model_fit_batch(sharded)
+                            self.stats.examples += d.num_examples()
+                            self.stats.minibatches += 1
                     if wd is None:
                         one_step()
                     else:

@@ -83,24 +83,7 @@ class CollectiveWatchdog:
         """Bounded ``jax.block_until_ready`` over a pytree. Returns the tree
         on success; raises CollectiveTimeoutError (or aborts) on deadline."""
         import jax
-        done = threading.Event()
-        err: list = []
-
-        def wait():
-            try:
-                jax.block_until_ready(tree)
-            except Exception as e:  # surfaced on the caller thread
-                err.append(e)
-            finally:
-                done.set()
-
-        t0 = time.monotonic()
-        t = threading.Thread(target=wait, daemon=True)
-        t.start()
-        if not done.wait(self.timeout_s):
-            self._expire(what, time.monotonic() - t0)
-        if err:
-            raise err[0]
+        self.call(lambda: jax.block_until_ready(tree), what)
         return tree
 
     # ------------------------------------------------------------------ call

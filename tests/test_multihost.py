@@ -66,12 +66,23 @@ def _run_workers(mode, tmp_path, timeout=420, require_ranks=(0, 1)):
 
 
 def _single_process_params(conf_fn, is_graph, epochs=5):
-    """Single-process training on the same seed/global batch."""
+    """Single-process training on the same seed/global batch. The worker
+    module appends device_count=4 to XLA_FLAGS on import (for its OWN
+    subprocess use) — restore the env and drop the module so no later test
+    or subprocess inherits the mutation."""
     import importlib.util
+    saved_flags = os.environ.get("XLA_FLAGS")
     spec = importlib.util.spec_from_file_location("mh_worker", _WORKER)
     w = importlib.util.module_from_spec(spec)
     sys.modules["mh_worker"] = w
-    spec.loader.exec_module(w)
+    try:
+        spec.loader.exec_module(w)
+    finally:
+        if saved_flags is None:
+            os.environ.pop("XLA_FLAGS", None)
+        else:
+            os.environ["XLA_FLAGS"] = saved_flags
+        sys.modules.pop("mh_worker", None)
     from deeplearning4j_tpu.nn.graph import ComputationGraph
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     conf = getattr(w, conf_fn)()
