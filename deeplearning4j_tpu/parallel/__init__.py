@@ -16,6 +16,11 @@ from deeplearning4j_tpu.parallel.stats import TrainingStats  # noqa: F401
 from deeplearning4j_tpu.parallel.watchdog import (  # noqa: F401
     CollectiveTimeoutError, CollectiveWatchdog,
 )
+from deeplearning4j_tpu.parallel.pipeline import (  # noqa: F401
+    GPipeTrainer,
+    make_pipeline_mesh,
+    pipeline_apply,
+)
 from deeplearning4j_tpu.parallel.ulysses import (  # noqa: F401
     ulysses_self_attention,
 )

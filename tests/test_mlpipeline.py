@@ -1,0 +1,133 @@
+"""sklearn-compatible estimator tests (mlpipeline.py) — the Python analogue
+of the reference's dl4j-spark-ml Estimator/Transformer suite
+(SparkDl4jNetwork fit/transform inside ML Pipelines)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.mlpipeline import DL4JClassifier, DL4JRegressor
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.updaters import Adam
+
+
+def _cls_conf():
+    return (NeuralNetConfiguration.builder()
+            .seed(7).updater(Adam(0.02)).weight_init("xavier").list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+
+
+def _reg_conf():
+    return (NeuralNetConfiguration.builder()
+            .seed(7).updater(Adam(0.02)).weight_init("xavier").list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=1, activation="identity", loss="mse"))
+            .set_input_type(InputType.feed_forward(3)).build())
+
+
+def _iris():
+    from sklearn.datasets import load_iris
+    d = load_iris()
+    return d.data.astype(np.float32), d.target
+
+
+def test_classifier_fit_predict_score():
+    X, y = _iris()
+    clf = DL4JClassifier(conf=_cls_conf, epochs=40, batch_size=32)
+    clf.fit(X, y)
+    assert clf.score(X, y) > 0.9
+    proba = clf.predict_proba(X[:5])
+    assert proba.shape == (5, 3)
+    np.testing.assert_allclose(proba.sum(-1), 1.0, atol=1e-4)
+    # string labels map back through classes_
+    names = np.array(["setosa", "versicolor", "virginica"])[y]
+    clf2 = DL4JClassifier(conf=_cls_conf, epochs=40).fit(X, names)
+    assert set(clf2.predict(X[:10])) <= set(names)
+
+
+def test_classifier_in_sklearn_pipeline_and_clone():
+    from sklearn.base import clone
+    from sklearn.pipeline import Pipeline
+    from sklearn.preprocessing import StandardScaler
+    X, y = _iris()
+    pipe = Pipeline([
+        ("scale", StandardScaler()),
+        ("net", DL4JClassifier(conf=_cls_conf, epochs=40)),
+    ])
+    pipe.fit(X, y)
+    assert pipe.score(X, y) > 0.9
+    # sklearn clone round-trips get_params/__init__
+    c = clone(pipe.named_steps["net"])
+    assert c.epochs == 40 and not hasattr(c, "model_")
+
+
+def test_classifier_grid_search():
+    from sklearn.model_selection import GridSearchCV
+    X, y = _iris()
+    gs = GridSearchCV(DL4JClassifier(conf=_cls_conf, batch_size=32),
+                      {"epochs": [5, 25]}, cv=2, n_jobs=1)
+    gs.fit(X, y)
+    assert gs.best_params_["epochs"] in (5, 25)
+    assert gs.best_score_ > 0.6
+
+
+def test_regressor_r2():
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((256, 3)).astype(np.float32)
+    y = 2.0 * X[:, 0] - 1.5 * X[:, 1] + 0.5
+    reg = DL4JRegressor(conf=_reg_conf, epochs=60, batch_size=64)
+    reg.fit(X, y)
+    assert reg.score(X, y) > 0.9
+    assert reg.predict(X[:4]).shape == (4,)
+
+
+def test_unfitted_and_param_validation():
+    clf = DL4JClassifier(conf=_cls_conf)
+    with pytest.raises(RuntimeError, match="not fitted"):
+        clf.predict(np.zeros((1, 4), np.float32))
+    with pytest.raises(ValueError, match="Invalid parameter"):
+        clf.set_params(bogus=1)
+    with pytest.raises(ValueError, match="configuration"):
+        DL4JClassifier().fit(np.zeros((4, 2), np.float32), [0, 1, 0, 1])
+
+
+def test_classifier_with_computation_graph_conf():
+    from deeplearning4j_tpu.nn.conf.graph import GraphBuilder
+    from deeplearning4j_tpu.nn.conf.network import Builder as NNBuilder
+
+    def gconf():
+        parent = NNBuilder()
+        parent.seed(7).updater(Adam(0.02)).weight_init("xavier")
+        return (GraphBuilder(parent)
+                .add_inputs("in")
+                .add_layer("h", DenseLayer(n_out=16, activation="relu"), "in")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "h")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(4))
+                .build())
+
+    X, y = _iris()
+    clf = DL4JClassifier(conf=gconf, epochs=40)
+    clf.fit(X, y)
+    assert clf.predict(X[:7]).shape == (7,)
+    assert clf.predict_proba(X[:7]).shape == (7, 3)
+    assert clf.score(X, y) > 0.9
+
+
+def test_classifier_score_accepts_onehot():
+    X, y = _iris()
+    Y = np.eye(3, dtype=np.float32)[y]
+    clf = DL4JClassifier(conf=_cls_conf, epochs=30).fit(X, Y)
+    s_onehot = clf.score(X, Y)
+    s_labels = clf.score(X, y)
+    assert s_onehot == s_labels > 0.85
+
+
+def test_pipeline_mesh_validates_device_count():
+    from deeplearning4j_tpu.parallel.pipeline import make_pipeline_mesh
+    import jax
+    with pytest.raises(ValueError, match="stages"):
+        make_pipeline_mesh(len(jax.devices()) + 1)
