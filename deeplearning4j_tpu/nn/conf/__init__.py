@@ -13,3 +13,4 @@ from deeplearning4j_tpu.nn.conf import objdetect as _objdetect  # noqa: F401,E40
 from deeplearning4j_tpu.nn.conf import pretrain as _pretrain  # noqa: F401,E402
 from deeplearning4j_tpu.nn.conf import variational as _vae  # noqa: F401,E402
 from deeplearning4j_tpu.nn.conf import regularization as _reg  # noqa: F401,E402
+from deeplearning4j_tpu.nn.conf import attention as _attn  # noqa: F401,E402

@@ -1,0 +1,212 @@
+"""Self-attention layers: long context as a first-class LAYER API.
+
+Parity surface: the reference line's successor API — deeplearning4j
+1.0.0-beta ``nn/conf/layers/SelfAttentionLayer.java`` /
+``LearnedSelfAttentionLayer.java`` (DL4J 0.9.x itself predates attention;
+these layers complete the sequence-model family the way the project's own
+later releases did). TPU-native: the score math runs through the Pallas
+flash-attention kernel on TPU (``parallel/ring_attention.py`` — tiled
+online softmax, no (T, T) materialization) when shapes satisfy the kernel's
+block constraints; padded batches, tiny sequences, and off-TPU runs use the
+masked dense path (``reference_attention``, shared with the ring/Ulysses
+parity tests so there is exactly ONE dense implementation). For sequences
+beyond one chip, the same math shards over the mesh via
+``ring_self_attention`` / ``ulysses_self_attention`` (parallel/).
+
+Param layout: nested ``{"q": {"W", "b"}, "k": ..., "v": ..., "o": ...}``
+(plus ``ff1``/``ff2`` in the encoder block) so the framework's bias-aware
+machinery — l1_bias/l2_bias regularization, bias constraints, weight noise
+``apply_to_bias`` — discovers the biases through the standard
+``<prefix>/b`` sibling rule (layers.py ``_bias_keys``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    BaseLayer, dropout_input, register_layer,
+)
+from deeplearning4j_tpu.nn.initializers import init_weights
+
+
+def _heads(x, h):
+    b, t, d = x.shape
+    return x.reshape(b, t, h, d // h).transpose(0, 2, 1, 3)
+
+
+def _unheads(x):
+    b, h, t, dh = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, t, h * dh)
+
+
+def _proj(p, x):
+    z = x @ p["W"]
+    return z + p["b"] if "b" in p else z
+
+
+def _attend(params, x, mask, n_heads: int, causal: bool):
+    """Shared multi-head attention core over nested q/k/v/o param groups.
+    Uses the Pallas flash kernel when the shapes meet its block constraints
+    and there is no padding mask; the dense path is reference_attention."""
+    from deeplearning4j_tpu.parallel.ring_attention import (
+        flash_self_attention, reference_attention,
+    )
+    q = _heads(_proj(params["q"], x), n_heads)
+    k = _heads(_proj(params["k"], x), n_heads)
+    v = _heads(_proj(params["v"], x), n_heads)
+    out = None
+    if mask is None and q.shape[2] >= 128:
+        try:  # flash on TPU; falls back to the dense reference off-TPU
+            out = flash_self_attention(q, k, v, causal=causal)
+        except ValueError:  # kernel block constraints (shape-dependent)
+            out = None
+    if out is None:
+        out = reference_attention(q, k, v, causal=causal, key_mask=mask)
+    return _proj(params["o"], _unheads(out))
+
+
+def _qkvo_params(rng, n_in: int, d: int, layer, dtype):
+    ks = jax.random.split(rng, 4)
+    out = {}
+    for key, k_, din, dout in (("q", ks[0], n_in, d), ("k", ks[1], n_in, d),
+                               ("v", ks[2], n_in, d), ("o", ks[3], d, d)):
+        g = {"W": init_weights(k_, (din, dout), din, dout, layer.weight_init,
+                               layer.dist, dtype)}
+        if layer.has_bias:
+            g["b"] = jnp.full((dout,), layer.bias_init, dtype)
+        out[key] = g
+    return out
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class SelfAttentionLayer(BaseLayer):
+    """Multi-head self-attention over (batch, time, features).
+
+    ``n_out`` is the model width (divisible by ``n_heads``); Q/K/V and the
+    output projection are learned. ``causal=True`` gives autoregressive
+    masking; the framework's feature masks become key padding masks and
+    masked timesteps emit zeros (the recurrent-layer output contract).
+    """
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    n_heads: int = 4
+    causal: bool = False
+    has_bias: bool = True
+    activation: str = "identity"
+
+    def input_kind(self):
+        return "rnn"
+
+    def is_recurrent(self):
+        return True
+
+    supports_stateful = False  # full-sequence layer: no rnn_time_step carry
+
+    def regularizable(self):
+        return ("q/W", "k/W", "v/W", "o/W")
+
+    def output_type(self, it: InputType) -> InputType:
+        if self.n_out % self.n_heads:
+            raise ValueError(
+                f"n_out {self.n_out} not divisible by n_heads {self.n_heads}")
+        return InputType.recurrent(self.n_out, it.timeseries_length)
+
+    def init(self, rng, it: InputType, dtype=jnp.float32):
+        n_in = self.n_in or it.size
+        return _qkvo_params(rng, n_in, self.n_out, self, dtype), {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = dropout_input(x, self.dropout, train, rng)
+        out = get_activation(self.activation)(
+            _attend(params, x, mask, self.n_heads, self.causal))
+        if mask is not None:  # masked steps emit zeros, post-activation
+            out = out * mask[..., None].astype(out.dtype)
+        return out, state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class TransformerEncoderBlock(BaseLayer):
+    """Pre-LN transformer block: LN -> MHA -> residual, LN -> FFN(gelu) ->
+    residual. Width-preserving (n_out == n_in); stack for depth. Shares the
+    attention core with :class:`SelfAttentionLayer` (flash kernel on TPU)."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0              # model width; inferred from input when 0
+    n_heads: int = 4
+    ff_size: int = 0            # defaults to 4*width
+    causal: bool = False
+    has_bias: bool = True
+    ff_activation: str = "gelu"
+    activation: str = "identity"
+
+    def input_kind(self):
+        return "rnn"
+
+    def is_recurrent(self):
+        return True
+
+    supports_stateful = False
+
+    def regularizable(self):
+        return ("q/W", "k/W", "v/W", "o/W", "ff1/W", "ff2/W")
+
+    def _width(self, it: InputType) -> int:
+        return self.n_out or self.n_in or it.size
+
+    def output_type(self, it: InputType) -> InputType:
+        d = self._width(it)
+        if it.size and d != it.size:
+            raise ValueError(
+                f"TransformerEncoderBlock is residual: width {d} must match "
+                f"input size {it.size}")
+        if d % self.n_heads:
+            raise ValueError(
+                f"width {d} not divisible by n_heads {self.n_heads}")
+        return InputType.recurrent(d, it.timeseries_length)
+
+    def init(self, rng, it: InputType, dtype=jnp.float32):
+        d = self._width(it)
+        ff = self.ff_size or 4 * d
+        k_attn, k1, k2 = jax.random.split(rng, 3)
+        params = _qkvo_params(k_attn, d, d, self, dtype)
+        for key, k_, din, dout in (("ff1", k1, d, ff), ("ff2", k2, ff, d)):
+            g = {"W": init_weights(k_, (din, dout), din, dout,
+                                   self.weight_init, self.dist, dtype)}
+            if self.has_bias:
+                g["b"] = jnp.full((dout,), self.bias_init, dtype)
+            params[key] = g
+        params["ln1_g"] = jnp.ones((d,), dtype)
+        params["ln1_b"] = jnp.zeros((d,), dtype)
+        params["ln2_g"] = jnp.ones((d,), dtype)
+        params["ln2_b"] = jnp.zeros((d,), dtype)
+        return params, {}
+
+    @staticmethod
+    def _ln(x, g, b, eps=1e-5):
+        mu = jnp.mean(x, -1, keepdims=True)
+        var = jnp.var(x, -1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = dropout_input(x, self.dropout, train, rng)
+        att_in = self._ln(x, params["ln1_g"], params["ln1_b"])
+        x = x + _attend(params, att_in, mask, self.n_heads, self.causal)
+        ff_in = self._ln(x, params["ln2_g"], params["ln2_b"])
+        h = get_activation(self.ff_activation)(_proj(params["ff1"], ff_in))
+        x = get_activation(self.activation)(x + _proj(params["ff2"], h))
+        if mask is not None:  # masked steps emit zeros, post-activation
+            x = x * mask[..., None].astype(x.dtype)
+        return x, state
+
+
+__all__ = ["SelfAttentionLayer", "TransformerEncoderBlock"]

@@ -27,15 +27,22 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def reference_attention(q, k, v, causal: bool = False):
+def reference_attention(q, k, v, causal: bool = False, key_mask=None):
     """Plain full-matrix attention (numerical reference / single-device path).
-    Shapes: (batch, heads, time, head_dim)."""
+    Shapes: (batch, heads, time, head_dim); optional ``key_mask`` (batch,
+    time) zeros out padded keys. The masked fill is a large finite negative
+    (dtype-aware), not -inf: a fully-masked row then softmaxes to uniform
+    finite weights instead of NaN (fp16's -1e9 would overflow to -inf)."""
     d = q.shape[-1]
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(d).astype(q.dtype)
+    neg = jnp.asarray(-0.7 * float(jnp.finfo(scores.dtype).max), scores.dtype)
     if causal:
         t_q, t_k = scores.shape[-2], scores.shape[-1]
         mask = jnp.tril(jnp.ones((t_q, t_k), bool))
-        scores = jnp.where(mask, scores, -jnp.inf)
+        scores = jnp.where(mask, scores, neg)
+    if key_mask is not None:
+        scores = jnp.where(key_mask[:, None, None, :].astype(bool),
+                           scores, neg)
     w = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", w, v)
 
