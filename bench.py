@@ -1,17 +1,23 @@
 """Benchmarks for the BASELINE configs, on one TPU chip.
 
-Covers BASELINE.json configs[0]-[3]:
+Covers BASELINE.json configs[0]-[3] plus the serving microbench:
   0. LeNet MultiLayerNetwork on MNIST            -> imgs/sec
   1. ResNet50 ComputationGraph (north star)      -> imgs/sec (+ MFU estimate)
   2. GravesLSTM char-RNN (tBPTT windows)         -> chars/sec
   3. Word2Vec skip-gram negative sampling        -> words/sec
+  4. ParallelInference serving (concurrent clients, mixed request sizes)
+                                                 -> req/sec + p50/p99 latency,
+                                                    batch-size summary, compiles
 
 The reference repo publishes no numbers (BASELINE.md); each ``vs_baseline``
 is reported against a fixed nominal V100-era denominator so the ratio is
 meaningful across rounds.
 
 Prints ONE JSON line per benchmark; the north-star ResNet50 line prints
-last. Set BENCH_QUICK=1 for a tiny smoke run (CI / CPU).
+last. Set BENCH_QUICK=1 for a tiny smoke run (CI / CPU);
+BENCH_ONLY=lenet,serving (comma list of bench names) restricts which
+benches run — the tier-1 smoke test uses it to exercise the bucketing +
+prefetch hot paths end-to-end without paying for the ResNet compile.
 """
 
 from __future__ import annotations
@@ -44,6 +50,7 @@ NOMINAL = {
     "resnet50": 360.0,      # imgs/sec, fp32 V100 ResNet50 ImageNet
     "charlstm": 100_000.0,  # chars/sec, cuDNN LSTM char-RNN
     "word2vec": 500_000.0,  # words/sec, multithreaded host SGNS
+    "serving": 10_000.0,    # req/sec, nominal GPU dynamic-batching server
 }
 
 
@@ -98,30 +105,34 @@ def bench_lenet():
 
     # plain per-batch fit(): reference MultiLayerNetwork.fit semantics —
     # one dispatch AND one listener firing per iteration (VERDICT r4 #7:
-    # report both so the headline isn't an API users must opt into)
+    # report both so the headline isn't an API users must opt into) — now
+    # driven through DevicePrefetchIterator so batch N+1's device_put
+    # overlaps step N (perf/prefetch.py)
     from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
     ds = [DataSet(x_np[(i % 4) * batch:(i % 4 + 1) * batch],
                   y_np[(i % 4) * batch:(i % 4 + 1) * batch])
           for i in range(group)]
+    it = ListDataSetIterator(ds, batch)
     net2 = LeNet(num_classes=10).init()
-    for d in ds[:2]:
-        net2.fit(d)
+    net2.fit(it, prefetch=True)  # compile + warmup
     float(net2._score)
 
     def timed_plain():
         t0 = time.perf_counter()
-        for _ in range(n_groups):
-            for d in ds:
-                net2.fit(d)
+        net2.fit(it, num_epochs=n_groups, prefetch=True)
         float(net2._score)
         return time.perf_counter() - t0
 
     dt2 = _best_of(timed_plain)
     emit("lenet_mnist_train_imgs_per_sec_per_chip_plain_fit",
          n_groups * group * batch / dt2, "imgs/sec", "lenet",
+         compiles=net2.compile_watch.compiles(),
+         dispatches=net2.compile_watch.dispatches(),
          note="reference-equivalent fit(): one dispatch + per-iteration "
-              "listener semantics per minibatch; dispatch-rate-bound "
-              "through the tunnel. " + _REPS_NOTE)
+              "listener semantics per minibatch, host->device transfer "
+              "double-buffered via DevicePrefetchIterator; dispatch-rate-"
+              "bound through the tunnel. " + _REPS_NOTE)
 
 
 def _model_fwd_flops_per_image(net) -> float:
@@ -322,9 +333,103 @@ def bench_word2vec():
               + _REPS_NOTE)
 
 
+def bench_serving():
+    """ParallelInference under concurrent clients with MIXED request sizes —
+    the workload where shape bucketing pays: without it every distinct
+    coalesced batch size is a fresh XLA compile mid-traffic. Reports
+    latency percentiles, batch-size summary and compile counters in one
+    JSON line (the shape-stability regression tripwire)."""
+    import threading
+
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    from deeplearning4j_tpu.parallel import ParallelInference
+    from deeplearning4j_tpu.perf import BucketPolicy
+
+    if QUICK:
+        n_clients, reqs_per_client, batch_limit, hidden = 4, 4, 16, 32
+    else:
+        n_clients, reqs_per_client, batch_limit, hidden = 16, 16, 32, 256
+    n_features, n_classes = 784, 10
+    conf = (NeuralNetConfiguration.builder()
+            .seed(11).updater(Sgd(learning_rate=0.01)).weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_out=n_classes, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(n_features))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    policy = BucketPolicy(floor=8)
+    pi = ParallelInference(net, batch_limit=batch_limit, queue_timeout_ms=3,
+                           bucket_policy=policy)
+    sizes = [1, 3, 7, 20, 4, 12, 2, 32][: max(4, batch_limit // 4)]
+    # pre-compile every bucket BEFORE traffic (the serving contract).
+    # batch_limit caps coalesced REQUESTS, not rows: the worst-case
+    # dispatch is every in-flight client's largest request in one batch.
+    max_rows = min(batch_limit, n_clients) * max(sizes)
+    t0 = time.perf_counter()
+    warmed = pi.warmup(np.zeros((1, n_features), np.float32),
+                       buckets=policy.buckets_up_to(max_rows))
+    warmup_s = time.perf_counter() - t0
+    compiles_after_warmup = net.compile_watch.compiles()
+    lat: list = []
+    lat_lock = threading.Lock()
+
+    def client(cid):
+        r = np.random.default_rng(cid)
+        for i in range(reqs_per_client):
+            x = r.standard_normal(
+                (sizes[(cid + i) % len(sizes)], n_features)).astype(np.float32)
+            t = time.perf_counter()
+            pi.output_batched(x)
+            with lat_lock:
+                lat.append(time.perf_counter() - t)
+
+    def timed():
+        t = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(n_clients)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        return time.perf_counter() - t
+
+    dt = _best_of(timed)
+    n_requests = n_clients * reqs_per_client
+    st = pi.stats()
+    pi.shutdown()
+    emit("parallel_inference_serving_reqs_per_sec", n_requests / dt,
+         "req/sec", "serving",
+         p50_ms=round(float(np.percentile(lat, 50)) * 1000, 2),
+         p99_ms=round(float(np.percentile(lat, 99)) * 1000, 2),
+         requests=n_requests,
+         batches_dispatched=st["batches_dispatched"],
+         batch_size=st["batch_size"],
+         warmed_buckets=warmed,
+         warmup_s=round(warmup_s, 2),
+         compiles=st.get("model_compiles"),
+         compiles_after_warmup=compiles_after_warmup,
+         unwarmed_dispatches=st["unwarmed_dispatches"],
+         note="concurrent clients, request sizes cycling %s; every dispatch "
+              "pads to a warmed bucket, so compiles == compiles_after_warmup "
+              "must hold (shape-stability tripwire). " % sizes + _REPS_NOTE)
+
+
 def main():
     benches = [("lenet", bench_lenet), ("word2vec", bench_word2vec),
-               ("charlstm", bench_graveslstm), ("resnet50", bench_resnet50)]
+               ("charlstm", bench_graveslstm), ("serving", bench_serving),
+               ("resnet50", bench_resnet50)]
+    only = os.environ.get("BENCH_ONLY")
+    if only:
+        wanted = {w.strip() for w in only.split(",") if w.strip()}
+        unknown = wanted - {n for n, _ in benches}
+        if unknown:
+            raise SystemExit(f"BENCH_ONLY names unknown benches: "
+                             f"{sorted(unknown)}")
+        benches = [(n, f) for n, f in benches if n in wanted]
     for name, fn in benches:
         try:
             fn()

@@ -26,3 +26,7 @@ from deeplearning4j_tpu.nn.conf import (  # noqa: F401
 )
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401
 from deeplearning4j_tpu.nn.graph import ComputationGraph  # noqa: F401
+from deeplearning4j_tpu.perf import (  # noqa: F401
+    BucketPolicy,
+    DevicePrefetchIterator,
+)

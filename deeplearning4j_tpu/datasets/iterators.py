@@ -9,6 +9,13 @@ Iterators are plain Python iterables of :class:`DataSet` with ``reset()``;
 ``AsyncDataSetIterator`` prefetches on a background thread so host ETL overlaps
 device compute (same role as the reference's prefetch thread wrapped around
 fit() at MultiLayerNetwork.java:1161).
+
+``AsyncDataSetIterator`` covers only the HOST half of the overlap; the
+device half — issuing batch N+1's ``jax.device_put`` while step N runs —
+is :class:`~deeplearning4j_tpu.perf.prefetch.DevicePrefetchIterator`
+(re-exported here lazily). The two compose, Async innermost::
+
+    it = DevicePrefetchIterator(AsyncDataSetIterator(raw_iterator))
 """
 
 from __future__ import annotations
@@ -576,3 +583,12 @@ class EarlyTerminationMultiDataSetIterator(MultiDataSetIterator):
             if i >= self._max:
                 break
             yield mds
+
+
+def __getattr__(name):
+    # lazy re-export (PEP 562): perf.prefetch imports DataSetIterator from
+    # this module, so an eager import here would be circular
+    if name == "DevicePrefetchIterator":
+        from deeplearning4j_tpu.perf.prefetch import DevicePrefetchIterator
+        return DevicePrefetchIterator
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -28,6 +28,7 @@ from deeplearning4j_tpu.nn.conf.layers import (Layer, apply_constraints,
                                                dropout_input, noisy_params)
 from deeplearning4j_tpu.optimize.fused_update import bucketed_apply
 from deeplearning4j_tpu.optimize.updaters import gradient_normalization
+from deeplearning4j_tpu.perf.compile_watch import CompileWatch
 
 
 def _compute_dtype(name: str):
@@ -72,6 +73,8 @@ class ComputationGraph:
         self._rnn_carries = None
         self._last_features = None  # last fit minibatch (listener sampling)
         self._jit_cache = {}
+        # per-network compile/dispatch counters (perf/compile_watch.py)
+        self.compile_watch = CompileWatch("ComputationGraph")
 
     # ------------------------------------------------------------------ init
     def init(self, seed: Optional[int] = None) -> "ComputationGraph":
@@ -406,6 +409,7 @@ class ComputationGraph:
                 fn = jax.jit(score_fn)
             else:
                 raise KeyError(kind)
+            fn = self.compile_watch.wrap(fn, kind)
             self._jit_cache[kind] = fn
         return fn
 

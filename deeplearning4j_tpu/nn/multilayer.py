@@ -29,6 +29,7 @@ from deeplearning4j_tpu.nn.conf.layers import (apply_constraints,
 from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
 from deeplearning4j_tpu.optimize.fused_update import bucketed_apply
 from deeplearning4j_tpu.optimize.updaters import gradient_normalization
+from deeplearning4j_tpu.perf.compile_watch import CompileWatch
 import optax
 
 
@@ -81,6 +82,9 @@ class MultiLayerNetwork:
         self._score: Optional[float] = None
         self._rng = None
         self._jit_cache = {}
+        # per-network compile/dispatch counters (perf/compile_watch.py);
+        # every jitted program minted by _get_jitted records here
+        self.compile_watch = CompileWatch("MultiLayerNetwork")
         self._rnn_carries = None  # stateful rnnTimeStep carries
         self._last_features = None  # last fit minibatch (listener sampling)
 
@@ -283,7 +287,7 @@ class MultiLayerNetwork:
         return (jax.jit(fused, donate_argnums=(0, 1, 2)),
                 jax.jit(fused_nomask, donate_argnums=(0, 1, 2)))
 
-    def fit_fused(self, datasets) -> "MultiLayerNetwork":
+    def fit_fused(self, datasets, bucket_policy=None) -> "MultiLayerNetwork":
         """Train on a list of equally-shaped DataSets — or a pre-stacked
         ``(xs, ys)`` pair of (K, batch, ...) arrays — in ONE device dispatch
         (lax.scan over the stack). Equivalent to ``fit`` on each in order
@@ -292,7 +296,12 @@ class MultiLayerNetwork:
         them. Listeners fire once per fused group (with the last step's
         score) and ``iteration`` advances by the group size. Pass
         device-resident stacked arrays when re-fitting the same data (a
-        fresh host stack re-uploads the whole group each call)."""
+        fresh host stack re-uploads the whole group each call).
+
+        ``bucket_policy`` (perf.BucketPolicy, or True for the default) lets
+        the DataSet-list form carry a ragged final batch: every batch pads
+        to one bucket shape with the padding masked out of the loss, and
+        the whole group still runs as ONE compiled scan program."""
         if self.params is None:
             self.init()
         if self.conf.optimization_algo not in ("sgd",
@@ -316,6 +325,16 @@ class MultiLayerNetwork:
                     "batch of (features, labels) use fit()")
             n_steps = int(xs.shape[0])
         else:
+            datasets = list(datasets)
+            if bucket_policy is not None:
+                from deeplearning4j_tpu.perf.bucketing import (BucketPolicy,
+                                                               pad_dataset)
+                policy = (BucketPolicy() if bucket_policy is True
+                          else bucket_policy)
+                sizes = [d.num_examples() for d in datasets]
+                target = policy.bucket(max(sizes))
+                if any(s != target for s in sizes):
+                    datasets = [pad_dataset(d, target) for d in datasets]
             xs = jnp.stack([jnp.asarray(d.features) for d in datasets])
             ys = jnp.stack([jnp.asarray(d.labels) for d in datasets])
             n_steps = len(datasets)
@@ -459,6 +478,11 @@ class MultiLayerNetwork:
                 fn = jax.jit(score_fn)
             else:
                 raise KeyError(kind)
+            if isinstance(fn, tuple):  # train_fused: (masked, nomask) pair
+                fn = tuple(self.compile_watch.wrap(f, f"{kind}.{tag}")
+                           for f, tag in zip(fn, ("masked", "nomask")))
+            else:
+                fn = self.compile_watch.wrap(fn, kind)
             self._jit_cache[k] = fn
         return fn
 
@@ -537,10 +561,19 @@ class MultiLayerNetwork:
         return self
 
     # ------------------------------------------------------------------- fit
-    def fit(self, data, labels=None, num_epochs: int = 1):
+    def fit(self, data, labels=None, num_epochs: int = 1,
+            bucket_policy=None, prefetch: bool = False):
         """Train (reference MultiLayerNetwork.fit(DataSetIterator) :1156 and
         fit(INDArray, INDArray)). ``data`` may be a DataSetIterator-like
-        iterable of DataSets, a DataSet, or a features array with ``labels``."""
+        iterable of DataSets, a DataSet, or a features array with ``labels``.
+
+        ``bucket_policy`` (a perf.BucketPolicy, or True for the default)
+        pads every batch to a canonical bucket shape with the padded rows
+        masked out of the loss — an epoch with a ragged final batch then
+        runs ONE compiled program instead of recompiling the train step for
+        the tail (perf/bucketing.py; exact math for row-independent models,
+        see pad_dataset). ``prefetch=True`` stages each batch onto the
+        device while the previous step runs (perf/prefetch.py)."""
         if self.params is None:
             self.init()
         if labels is not None:
@@ -551,6 +584,12 @@ class MultiLayerNetwork:
                 "sgd", "stochastic_gradient_descent"):
             # full-batch solver path (reference Solver.java dispatch on
             # OptimizationAlgorithm — LBFGS / CG / line gradient descent)
+            if bucket_policy is not None or prefetch:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "fit(bucket_policy=/prefetch=) is ignored on the "
+                    "solver path (%s): these options apply to the jitted "
+                    "SGD step loop only", self.conf.optimization_algo)
             from deeplearning4j_tpu.optimize.solvers import Solver
             solver = Solver(self.conf.optimization_algo)
             for _ in range(num_epochs):
@@ -567,6 +606,15 @@ class MultiLayerNetwork:
                 self.epoch += 1
             return self
         train_step = self._get_jitted("train")
+        if bucket_policy is not None:
+            from deeplearning4j_tpu.perf.bucketing import (
+                BucketPadDataSetIterator, BucketPolicy)
+            policy = (BucketPolicy() if bucket_policy is True
+                      else bucket_policy)
+            data = BucketPadDataSetIterator(data, policy)
+        if prefetch:
+            from deeplearning4j_tpu.perf.prefetch import DevicePrefetchIterator
+            data = DevicePrefetchIterator(data)
         for _ in range(num_epochs):
             for listener in self.listeners:
                 listener.on_epoch_start(self)

@@ -9,6 +9,13 @@ replaces per-device replicas. Dynamic batching keeps the reference's shape:
 requests enqueue as observables; a background worker coalesces up to
 ``batch_limit`` requests (waiting at most ``queue_timeout_ms`` for
 stragglers) into ONE device dispatch and distributes the per-request slices.
+
+Shape stability: every dispatch pads to a canonical bucket size
+(perf/bucketing.BucketPolicy — on by default), so a serving mix of request
+sizes 1..32 compiles a handful of programs instead of one per distinct
+coalesced size; ``warmup()`` pre-compiles every bucket before traffic
+arrives, and ``stats()`` reports batch-size percentiles, per-bucket dispatch
+counts and the model's compile counters.
 """
 
 from __future__ import annotations
@@ -16,6 +23,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import Counter, deque
 from typing import List, Optional
 
 import jax
@@ -23,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.parallel.mesh import data_sharding, make_mesh, replicated
+from deeplearning4j_tpu.perf.bucketing import BucketPolicy, pad_to_bucket
 
 
 class InferenceObservable:
@@ -59,10 +68,19 @@ class ParallelInference:
 
     inference_mode: "batched" coalesces concurrent requests on a worker
     thread (reference InferenceMode.BATCHED); "sequential" dispatches each
-    request on the caller's thread (InferenceMode.SEQUENTIAL)."""
+    request on the caller's thread (InferenceMode.SEQUENTIAL).
+
+    bucket_policy: perf.BucketPolicy controlling the canonical dispatch
+    sizes (default: power-of-two buckets with floor 8). Pass ``None`` to
+    disable bucketing — every distinct padded batch size then compiles its
+    own program, which is almost never what you want in serving."""
+
+    _DEFAULT_POLICY = object()
 
     def __init__(self, model, mesh=None, batch_limit: int = 32,
-                 queue_timeout_ms: int = 5, inference_mode: str = "batched"):
+                 queue_timeout_ms: int = 5, inference_mode: str = "batched",
+                 bucket_policy=_DEFAULT_POLICY,
+                 batch_size_history: int = 1024):
         if inference_mode not in ("batched", "sequential"):
             raise ValueError(f"unknown inference_mode '{inference_mode}'")
         self.model = model
@@ -70,6 +88,9 @@ class ParallelInference:
         self.batch_limit = batch_limit
         self.queue_timeout_ms = queue_timeout_ms
         self.inference_mode = inference_mode
+        self.bucket_policy = (BucketPolicy()
+                              if bucket_policy is ParallelInference._DEFAULT_POLICY
+                              else bucket_policy)
         if model.params is None:
             model.init()
         repl = jax.tree_util.tree_map(lambda a: replicated(self.mesh), model.params)
@@ -82,24 +103,122 @@ class ParallelInference:
         # fails these too if the worker never comes back (wedged device call)
         self._inflight: List[InferenceObservable] = []
         self._inflight_lock = threading.Lock()
-        # observability (exercised by the latency/throughput tests)
+        # observability (exercised by the latency/throughput tests).
+        # batch_sizes is BOUNDED: sustained serving must not grow host
+        # memory; percentile summaries come from the retained window.
         self.requests_served = 0
         self.batches_dispatched = 0
-        self.batch_sizes: List[int] = []
+        self.batch_sizes: "deque" = deque(maxlen=max(1, batch_size_history))
+        self.bucket_dispatches: Counter = Counter()
+        self.unwarmed_dispatches = 0
+        self._warmed: set = set()
+        # sequential mode dispatches on arbitrary caller threads: counter
+        # updates are read-modify-write and need the lock
+        self._stats_lock = threading.Lock()
+
+    # --------------------------------------------------------- shape policy
+    def _pad_target(self, n: int) -> int:
+        """Dispatch size for an n-row batch: the policy's bucket, rounded up
+        to divide the mesh's data axis (the sequential path used to pad only
+        to the axis multiple — one compiled program PER SIZE; now both paths
+        share the bucket ladder). Zero-row batches bypass the ladder and
+        keep their (valid, if unusual) empty dispatch."""
+        dp = self.mesh.shape["data"]
+        t = (self.bucket_policy.bucket(n)
+             if self.bucket_policy is not None and n >= 1 else n)
+        return t + (-t) % dp
+
+    def _record_dispatch_shape(self, target: int):
+        with self._stats_lock:
+            self.bucket_dispatches[target] += 1
+            if target not in self._warmed:
+                self.unwarmed_dispatches += 1
 
     # ------------------------------------------------------------ sync path
-    def output(self, x) -> np.ndarray:
-        """Synchronous sharded inference (reference ParallelInference.output)."""
+    def _dispatch(self, arr, target: int, record: bool = True):
+        """Pad to EXACTLY ``target`` rows, shard, run the model, slice the
+        real rows back out. The single choke point for device dispatches —
+        warmup and live traffic go through it with the same shapes, so a
+        warmed target is guaranteed to be the compiled one. ``record=False``
+        (warmup) keeps the dispatch out of the serving counters PER CALL,
+        so concurrent live worker dispatches keep recording correctly."""
+        n = arr.shape[0]
         with self.mesh:
-            arr = jnp.asarray(x)
-            dp = self.mesh.shape["data"]
-            pad = (-arr.shape[0]) % dp
-            if pad:
-                arr = jnp.concatenate([arr, jnp.zeros((pad,) + arr.shape[1:],
-                                                      arr.dtype)])
+            arr = pad_to_bucket(jnp.asarray(arr), target)
+            if record:
+                self._record_dispatch_shape(target)
             arr = jax.device_put(arr, data_sharding(self.mesh, arr.ndim))
             out = self.model.output(arr)
-            return out[:out.shape[0] - pad] if pad else out
+            return out[:n] if target != n else out
+
+    def output(self, x) -> np.ndarray:
+        """Synchronous sharded inference (reference ParallelInference.output),
+        padded to the bucket ladder so repeat traffic reuses compiled
+        programs."""
+        arr = jnp.asarray(x)
+        return self._dispatch(arr, self._pad_target(arr.shape[0]))
+
+    def warmup(self, example, buckets=None) -> List[int]:
+        """Pre-compile the forward program for every bucket BEFORE traffic
+        arrives, so no live request ever pays a multi-second XLA compile.
+
+        ``example``: an array with a leading batch axis — ideally a
+        REPRESENTATIVE request, because the default bucket set assumes the
+        worst coalesced batch is ``batch_limit`` requests of this size
+        (``batch_limit`` caps coalesced REQUESTS, not rows). Pass explicit
+        ``buckets`` (batch sizes to warm) when traffic mixes request sizes;
+        warm up to your worst-case coalesced row count (see
+        bench.py::bench_serving). Returns the warmed dispatch sizes."""
+        ex = np.asarray(example)
+        if ex.ndim < 1:
+            raise ValueError("warmup example needs a leading batch axis")
+        feat_shape = ex.shape[1:]
+        if buckets is None:
+            max_rows = max(1, self.batch_limit) * max(1, ex.shape[0])
+            if self.bucket_policy is None:
+                buckets = [max_rows]
+            else:
+                buckets = self.bucket_policy.buckets_up_to(max_rows)
+        for b in sorted({int(b) for b in buckets}):
+            target = self._pad_target(b)
+            if target in self._warmed:
+                continue
+            # dispatch EXACTLY target rows (not through output(), whose
+            # re-bucketing could compile a different shape than live
+            # traffic dispatches when target isn't a policy fixed point),
+            # unrecorded so warmup doesn't pollute the serving counters
+            self._dispatch(np.zeros((target,) + feat_shape, ex.dtype),
+                           target, record=False)
+            self._warmed.add(target)
+        return sorted(self._warmed)
+
+    def stats(self) -> dict:
+        """Serving observability: request/dispatch counts, batch-size
+        percentiles over the retained window, per-bucket dispatch counts,
+        warmed buckets, and the model's compile/dispatch counters."""
+        sizes = list(self.batch_sizes)
+        summary = {"count": len(sizes)}
+        if sizes:
+            summary.update(
+                mean=round(float(np.mean(sizes)), 2),
+                p50=float(np.percentile(sizes, 50)),
+                p95=float(np.percentile(sizes, 95)),
+                max=int(max(sizes)))
+        out = {
+            "requests_served": self.requests_served,
+            "batches_dispatched": self.batches_dispatched,
+            "batch_size": summary,
+            "bucket_policy": (None if self.bucket_policy is None
+                              else repr(self.bucket_policy)),
+            "warmed_buckets": sorted(self._warmed),
+            "bucket_dispatches": dict(self.bucket_dispatches),
+            "unwarmed_dispatches": self.unwarmed_dispatches,
+        }
+        cw = getattr(self.model, "compile_watch", None)
+        if cw is not None:
+            out["model_compiles"] = cw.compiles()
+            out["model_dispatches"] = cw.dispatches()
+        return out
 
     # -------------------------------------------------------- batched path
     def submit(self, x) -> InferenceObservable:
@@ -111,7 +230,8 @@ class ParallelInference:
                 obs._resolve(self.output(np.asarray(x)))
             except BaseException as e:  # surfaced at .get()
                 obs._fail(e)
-            self.requests_served += 1
+            with self._stats_lock:
+                self.requests_served += 1
             return obs
         # enqueue + worker liveness under one lock: a concurrent shutdown()
         # (same lock) can then never strand this request between the put and

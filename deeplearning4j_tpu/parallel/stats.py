@@ -9,6 +9,11 @@ TPU-native phases: ``data_placement`` (host->device sharded transfer),
 ``train_dispatch`` (async step dispatch), ``epoch_sync`` (the single
 block-until-ready per epoch — on TPU the real step time shows up here, since
 dispatch is asynchronous).
+
+Besides phase timings, integer ``counters`` carry point-in-time gauges —
+notably ``model_compiles``/``model_dispatches`` from perf/compile_watch.py,
+so a recompile storm (the silent TPU performance killer) shows up right next
+to the timings it inflates.
 """
 
 from __future__ import annotations
@@ -25,6 +30,7 @@ class TrainingStats:
         self._durations: Dict[str, List[float]] = {}
         self.examples = 0
         self.minibatches = 0
+        self.counters: Dict[str, int] = {}
 
     # -------------------------------------------------------------- record
     class _Timer:
@@ -45,6 +51,13 @@ class TrainingStats:
     def record(self, phase: str, seconds: float):
         self._durations.setdefault(phase, []).append(seconds)
 
+    def set_counter(self, name: str, value: int):
+        """Set a point-in-time gauge (e.g. cumulative compile count)."""
+        self.counters[name] = int(value)
+
+    def inc_counter(self, name: str, by: int = 1):
+        self.counters[name] = self.counters.get(name, 0) + int(by)
+
     # --------------------------------------------------------------- query
     def key_set(self):
         return sorted(self._durations)
@@ -60,6 +73,8 @@ class TrainingStats:
 
     def as_dict(self) -> dict:
         out = {"examples": self.examples, "minibatches": self.minibatches}
+        if self.counters:
+            out["counters"] = dict(self.counters)
         for phase, ds in self._durations.items():
             out[phase] = {"count": len(ds), "total_ms": sum(ds) * 1000.0,
                           "mean_ms": sum(ds) / len(ds) * 1000.0}
@@ -73,4 +88,6 @@ class TrainingStats:
             lines.append(f"  {phase:<16} n={len(ds):<6} "
                          f"total={sum(ds) * 1000:9.1f} ms  "
                          f"mean={sum(ds) / len(ds) * 1000:7.2f} ms")
+        for name in sorted(self.counters):
+            lines.append(f"  {name:<16} {self.counters[name]}")
         return "\n".join(lines)

@@ -192,11 +192,19 @@ class ParallelWrapper:
         return True
 
     # ---- training (reference ParallelWrapper.fit dispatch loop :210) ----
-    def fit(self, data, num_epochs: int = 1):
+    def fit(self, data, num_epochs: int = 1, prefetch: bool = False):
+        """``prefetch=True`` wraps the iterator in a DevicePrefetchIterator
+        (perf/prefetch.py): batch N+1's sharded device_put is issued while
+        step N runs, so host→device transfer stops serializing the step
+        loop. Ragged batches pass through on host and keep the usual
+        drop-ragged policy."""
         self._place_params()
         explicit_single = isinstance(data, DataSet)
         if explicit_single:
             data = [data]
+        elif prefetch:
+            from deeplearning4j_tpu.perf.prefetch import DevicePrefetchIterator
+            data = DevicePrefetchIterator(data, mesh=self.mesh)
         for _ in range(num_epochs):
             for listener in self.model.listeners:
                 listener.on_epoch_start(self.model)
@@ -225,7 +233,17 @@ class ParallelWrapper:
                 # the true device time under "epoch_sync"
                 with self.stats.time("epoch_sync"):
                     jax.block_until_ready(self.model.params)
+                self._record_compile_counters()
         return self
+
+    def _record_compile_counters(self):
+        """Surface the model's compile/dispatch counts in TrainingStats —
+        'N minibatches, 1 compile' becomes assertable next to the phase
+        timings (perf/compile_watch.py)."""
+        cw = getattr(self.model, "compile_watch", None)
+        if self.stats is not None and cw is not None:
+            self.stats.set_counter("model_compiles", cw.compiles())
+            self.stats.set_counter("model_dispatches", cw.dispatches())
 
     def output(self, x) -> np.ndarray:
         self._place_params()
@@ -306,13 +324,23 @@ class ClusterTrainer(ParallelWrapper):
                           // max(1, jax.process_count()))
         return bool(ds.num_examples() % local_share)
 
-    def fit(self, data, num_epochs: int = 1):
+    def fit(self, data, num_epochs: int = 1, prefetch: bool = False):
         """Train from an ORDINARY global iterator: every process walks the
         same iterator and this trainer internally takes the process's row
         shard of each batch (parallel/sharding.py), so user code needs no
         manual pre-sharding (reference SparkDl4jMultiLayer.fit(RDD)
-        ergonomics)."""
+        ergonomics).
+
+        ``prefetch`` is accepted for signature parity with
+        ParallelWrapper.fit but is a no-op here: the multi-host path
+        assembles each global batch from process-LOCAL host rows
+        (``make_array_from_process_local_data``), which has no
+        pre-placeable single-device layout."""
         from deeplearning4j_tpu.parallel.sharding import shard_iterator
+        if prefetch:
+            log.warning("ClusterTrainer.fit(prefetch=True) is a no-op: "
+                        "global batches are assembled from process-local "
+                        "rows at dispatch time")
         if isinstance(data, DataSet):
             data = [data]
         local = shard_iterator(data) if jax.process_count() > 1 else data
@@ -385,6 +413,7 @@ class ClusterTrainer(ParallelWrapper):
                 for listener in self.model.listeners:
                     listener.on_epoch_end(self.model)
                 self.model.epoch += 1
+                self._record_compile_counters()
             if wd is not None:
                 # tail steps after the last every-N sync must not escape the
                 # deadline — a hang there would otherwise surface only at

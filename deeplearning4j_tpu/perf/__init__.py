@@ -1,0 +1,26 @@
+"""Performance subsystem: shape-stable execution + host↔device overlap.
+
+Three cooperating pieces (see each module's docstring):
+
+- ``bucketing``     — BucketPolicy / pad_to_bucket / unpad / pad_dataset:
+                      canonical batch shapes so XLA compiles once per bucket,
+                      not once per batch size;
+- ``prefetch``      — DevicePrefetchIterator: double-buffered, sharding-aware
+                      device placement of batch N+1 while step N runs;
+- ``compile_watch`` — CompileWatch: compile/dispatch counters so tests and
+                      benches can assert "N batches, 1 compile".
+"""
+
+from deeplearning4j_tpu.perf.bucketing import (  # noqa: F401
+    BucketPadDataSetIterator,
+    BucketPolicy,
+    pad_dataset,
+    pad_to_bucket,
+    unpad,
+)
+from deeplearning4j_tpu.perf.compile_watch import (  # noqa: F401
+    GLOBAL as GLOBAL_COMPILE_WATCH,
+    CompileWatch,
+    backend_compile_events,
+)
+from deeplearning4j_tpu.perf.prefetch import DevicePrefetchIterator  # noqa: F401

@@ -1,0 +1,214 @@
+"""Shape bucketing — static-shape execution under dynamic batch sizes.
+
+On XLA every distinct input shape is a fresh compilation (seconds), not a
+cheap dispatch (microseconds) — the opposite cost model from ND4J, where
+``INDArray`` ops take any shape. A serving mix of batch sizes 1..32 therefore
+compiles up to 32 programs unless batches are padded to a small set of
+canonical sizes. ``BucketPolicy`` defines that set (power-of-two rounding
+between a floor and a cap, or an explicit bucket list); ``pad_to_bucket`` /
+``unpad`` move arrays in and out of bucket shapes; ``pad_dataset`` pads a
+training batch *with a label mask over the padded rows*, so the masked loss
+(sum(score*mask)/sum(mask) — nn/lossfunctions.score) is mathematically
+identical to the unpadded batch.
+
+Reference analogue: none — the JVM stack never needed this. It is part of
+the execution substrate the TPU port must supply itself (PAPER.md).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class BucketPolicy:
+    """Round batch sizes up to a canonical bucket.
+
+    Default: the next power of two, clamped to ``[floor, cap]`` (sizes above
+    ``cap`` round up to a multiple of ``cap`` instead of a power of two, so
+    huge batches don't double their padding). An explicit ``buckets`` list
+    overrides the power-of-two ladder; sizes above its largest bucket round
+    up to a multiple of it.
+    """
+
+    def __init__(self, floor: int = 8, cap: int = 1024,
+                 buckets: Optional[Sequence[int]] = None):
+        if floor < 1:
+            raise ValueError(f"floor must be >= 1, got {floor}")
+        if cap < floor:
+            raise ValueError(f"cap {cap} must be >= floor {floor}")
+        self.floor = int(floor)
+        self.cap = int(cap)
+        self._explicit: Optional[List[int]] = (
+            sorted(int(b) for b in buckets) if buckets else None)
+        if self._explicit and self._explicit[0] < 1:
+            raise ValueError("explicit buckets must be positive")
+
+    def bucket(self, n: int) -> int:
+        """Smallest bucket >= n."""
+        if n < 1:
+            raise ValueError(f"batch size must be >= 1, got {n}")
+        if self._explicit is not None:
+            for b in self._explicit:
+                if n <= b:
+                    return b
+            top = self._explicit[-1]
+            return -(-n // top) * top
+        if n <= self.floor:
+            return self.floor
+        if n > self.cap:
+            return -(-n // self.cap) * self.cap
+        # clamp: a non-power-of-two cap must never be overshot by the pow2
+        # ladder (cap is typically a memory budget)
+        return min(1 << (int(n) - 1).bit_length(), self.cap)
+
+    def buckets_up_to(self, n: int) -> List[int]:
+        """All distinct buckets that sizes 1..n can map to (the warmup set)."""
+        out, b = [], 1
+        while b < n:
+            bb = self.bucket(b)
+            out.append(bb)
+            b = bb + 1
+        if not out or out[-1] < self.bucket(n):
+            out.append(self.bucket(n))
+        return out
+
+    def __repr__(self):
+        if self._explicit is not None:
+            return f"BucketPolicy(buckets={self._explicit})"
+        return f"BucketPolicy(floor={self.floor}, cap={self.cap})"
+
+
+def pad_to_bucket(arr, target: int, axis: int = 0):
+    """Zero-pad ``arr`` along ``axis`` to ``target`` rows (no-op if equal).
+
+    Works on numpy and jax arrays alike (jax arrays stay on device via
+    ``jnp.concatenate``; numpy stays host-side).
+    """
+    n = arr.shape[axis]
+    if n == target:
+        return arr
+    if n > target:
+        raise ValueError(f"cannot pad {n} rows down to {target}")
+    shape = list(arr.shape)
+    shape[axis] = target - n
+    if isinstance(arr, np.ndarray):
+        return np.concatenate([arr, np.zeros(shape, arr.dtype)], axis=axis)
+    import jax.numpy as jnp
+    return jnp.concatenate([arr, jnp.zeros(shape, arr.dtype)], axis=axis)
+
+
+def unpad(arr, n: int, axis: int = 0):
+    """Slice the first ``n`` rows back out of a bucket-padded array."""
+    if arr.shape[axis] == n:
+        return arr
+    sl = [slice(None)] * arr.ndim
+    sl[axis] = slice(0, n)
+    return arr[tuple(sl)]
+
+
+@functools.lru_cache(maxsize=64)
+def _ones_like_mask(mask_row_shape, n_real: int, target: int):
+    """(target, *mask_row_shape) mask: 1 for real rows, 0 for padding.
+
+    Cached: the bucket iterator fabricates this for EVERY batch of every
+    epoch (jit-signature uniformity), and it depends only on the shapes.
+    Callers must treat the returned array as read-only."""
+    m = np.zeros((target,) + tuple(mask_row_shape), np.float32)
+    m[:n_real] = 1.0
+    m.setflags(write=False)
+    return m
+
+
+def pad_dataset(ds: DataSet, target: int, ensure_lmask: bool = False) -> DataSet:
+    """Pad a training DataSet to ``target`` examples, masking padded rows.
+
+    - features/labels zero-pad;
+    - ``labels_mask`` gains zero rows for the padding, so the masked loss
+      (sum(score*mask)/sum(mask)) excludes the padding with the correct
+      denominator. When absent it is fabricated: from ``features_mask``
+      (zero-padded) if one exists — the mask the loss would have inherited
+      for sequence outputs — else ones-over-real-rows, shape (batch,) for
+      2-D labels or (batch, T) for 3-D sequence labels;
+    - ``features_mask`` pads with ONES, not zeros: padded rows are all-zero
+      features, and an all-zero per-row feature mask would make masked
+      time-pooling divide 0/0.
+
+    ``ensure_lmask=True`` attaches the fabricated all-ones labels mask even
+    when no padding happens — numerically identical (mask of ones), but it
+    keeps the jit signature UNIFORM across an epoch whose final batch is
+    padded, which is what makes the epoch a single compiled program.
+
+    Exactness caveat: layers that couple examples across the batch
+    (BatchNorm in train mode) see the padded rows in their batch statistics;
+    everything row-independent is bit-identical up to float association.
+    """
+    n = ds.num_examples()
+    if n == target and not (ensure_lmask and ds.labels_mask is None):
+        return ds
+    feats = pad_to_bucket(ds.features, target)
+    labels = pad_to_bucket(ds.labels, target)
+    labels_nd = np.asarray(ds.labels).ndim
+    if ds.labels_mask is not None:
+        lmask = np.concatenate([
+            np.asarray(ds.labels_mask, np.float32),
+            np.zeros((target - n,) + np.asarray(ds.labels_mask).shape[1:],
+                     np.float32)])
+    elif ds.features_mask is not None and labels_nd >= 3:
+        # sequence OUTPUTS: the loss would have used the propagated features
+        # mask; carry it over with zero rows for the padding (exact whenever
+        # the mask reaches the output layer unchanged — the common rnn case)
+        lmask = np.concatenate([
+            np.asarray(ds.features_mask, np.float32),
+            np.zeros((target - n,) + np.asarray(ds.features_mask).shape[1:],
+                     np.float32)])
+    else:
+        # 2-D labels (incl. masked-sequence-INPUT classifiers, where the
+        # time mask dies with the collapsed time axis and the loss runs
+        # unmasked): per-example (batch,) mask matches the score shape
+        row_shape = (np.asarray(ds.labels).shape[1:-1]
+                     if labels_nd >= 3 else ())
+        lmask = _ones_like_mask(row_shape, n, target)
+    if ds.features_mask is not None:
+        fmask = np.concatenate([
+            np.asarray(ds.features_mask, np.float32),
+            np.ones((target - n,) + np.asarray(ds.features_mask).shape[1:],
+                    np.float32)])
+    else:
+        fmask = None
+    return DataSet(feats, labels, fmask, lmask)
+
+
+class BucketPadDataSetIterator:
+    """Wrap any iterable of DataSets so every emitted batch lands on a
+    bucket shape (``pad_dataset`` semantics). Within one pass, a batch
+    smaller than the largest size already seen pads up to that size — so a
+    ragged FINAL batch reuses the epoch's one compiled program instead of
+    compiling a second, smaller one. Re-iterable iff the base is.
+    """
+
+    def __init__(self, base, policy: Optional[BucketPolicy] = None):
+        self._base = base
+        self.policy = policy if policy is not None else BucketPolicy()
+
+    def __iter__(self):
+        max_seen = 0
+        for ds in self._base:
+            target = max(self.policy.bucket(ds.num_examples()), max_seen)
+            max_seen = max(max_seen, target)
+            # ensure_lmask: full batches carry an all-ones mask so the
+            # padded tail shares their jit signature (one program per epoch)
+            yield pad_dataset(ds, target, ensure_lmask=True)
+
+    def reset(self):
+        if hasattr(self._base, "reset"):
+            self._base.reset()
+
+    def batch_size(self):
+        if hasattr(self._base, "batch_size"):
+            return self.policy.bucket(self._base.batch_size())
+        return None

@@ -1,0 +1,162 @@
+"""Compile/dispatch observability for jitted programs.
+
+On TPU the difference between "fast" and "30x slower than it should be" is
+usually invisible in the code: a recompile storm looks exactly like a slow
+step loop. This module makes it countable. ``CompileWatch.wrap`` wraps any
+``jax.jit`` callable so every call records one *dispatch* and — via the
+jitted function's executable-cache size delta — any *compile* it triggered.
+Tests and benches then assert "N batches, 1 compile" instead of guessing
+from wall clock.
+
+Counts aggregate per (watch, key) and into a process-wide ``GLOBAL`` watch;
+a ``jax.monitoring`` listener additionally counts backend compile events
+for code paths that never go through ``wrap`` (best-effort: the event
+stream's granularity varies across JAX versions, so exact assertions should
+use wrapped functions).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+def _cache_size(fn) -> Optional[int]:
+    """Executable-cache size of a jitted callable, or None when the JAX
+    version doesn't expose it (fallback: shape-signature counting)."""
+    try:
+        return int(fn._cache_size())
+    except Exception:
+        return None
+
+
+class CompileWatch:
+    """Per-key compile/dispatch counters. Thread-safe (the inference worker
+    dispatches from its own thread)."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._lock = threading.Lock()
+        self._compiles: Dict[str, int] = {}
+        self._dispatches: Dict[str, int] = {}
+
+    # ------------------------------------------------------------ recording
+    def _record(self, key: str, compiles: int, dispatches: int):
+        with self._lock:
+            self._compiles[key] = self._compiles.get(key, 0) + compiles
+            self._dispatches[key] = self._dispatches.get(key, 0) + dispatches
+
+    def wrap(self, fn, key: str) -> "_WatchedFunction":
+        """Wrap a jitted callable; every call records into this watch AND
+        the process-wide GLOBAL watch."""
+        return _WatchedFunction(fn, key, sinks=(self, GLOBAL))
+
+    # -------------------------------------------------------------- queries
+    def compiles(self, key: Optional[str] = None) -> int:
+        with self._lock:
+            if key is None:
+                return sum(self._compiles.values())
+            return self._compiles.get(key, 0)
+
+    def dispatches(self, key: Optional[str] = None) -> int:
+        with self._lock:
+            if key is None:
+                return sum(self._dispatches.values())
+            return self._dispatches.get(key, 0)
+
+    def reset(self):
+        with self._lock:
+            self._compiles.clear()
+            self._dispatches.clear()
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            return {
+                "compiles": sum(self._compiles.values()),
+                "dispatches": sum(self._dispatches.values()),
+                "by_key": {k: {"compiles": self._compiles.get(k, 0),
+                               "dispatches": self._dispatches.get(k, 0)}
+                           for k in sorted(set(self._compiles)
+                                           | set(self._dispatches))},
+            }
+
+
+GLOBAL = CompileWatch("global")
+
+
+class _WatchedFunction:
+    """Callable proxy over a jitted function. Compiles are detected from the
+    function's executable-cache growth; when that API is unavailable, from
+    first-sight of the call's (shape, dtype) signature — same answer for
+    shape-driven recompiles, which are the ones bucketing kills."""
+
+    def __init__(self, fn, key: str, sinks):
+        self._fn = fn
+        self._key = key
+        self._sinks = sinks
+        self._seen_sigs = set()
+        self._sig_lock = threading.Lock()
+
+    @staticmethod
+    def _signature(args, kwargs):
+        import jax
+        parts = []
+        for leaf in jax.tree_util.tree_leaves((args, kwargs)):
+            shape = getattr(leaf, "shape", None)
+            if shape is not None:
+                parts.append((tuple(shape), str(getattr(leaf, "dtype", ""))))
+            else:
+                parts.append((type(leaf).__name__,))
+        return tuple(parts)
+
+    def __call__(self, *args, **kwargs):
+        before = _cache_size(self._fn)
+        out = self._fn(*args, **kwargs)
+        after = _cache_size(self._fn)
+        if before is not None and after is not None:
+            compiled = max(0, after - before)
+        else:
+            sig = self._signature(args, kwargs)
+            with self._sig_lock:
+                compiled = 0 if sig in self._seen_sigs else 1
+                self._seen_sigs.add(sig)
+        for sink in self._sinks:
+            sink._record(self._key, compiled, 1)
+        return out
+
+    def __getattr__(self, name):  # lower/trace/cache introspection pass through
+        return getattr(self._fn, name)
+
+
+# --------------------------------------------------- backend event listener
+_backend_compile_events = 0
+_backend_lock = threading.Lock()
+_listener_installed = False
+
+
+def _install_listener():
+    global _listener_installed
+    if _listener_installed:
+        return
+    try:
+        import jax.monitoring as monitoring
+
+        def _on_event(name, **kwargs):
+            if "compile" in name:
+                global _backend_compile_events
+                with _backend_lock:
+                    _backend_compile_events += 1
+
+        monitoring.register_event_listener(_on_event)
+        _listener_installed = True
+    except Exception:  # pragma: no cover - older jax without monitoring
+        pass
+
+
+def backend_compile_events() -> int:
+    """Process-wide count of backend compile events (best-effort; install
+    happens on first query so importing this module stays side-effect-free
+    until observability is actually wanted)."""
+    _install_listener()
+    with _backend_lock:
+        return _backend_compile_events

@@ -1,0 +1,110 @@
+"""Host→device transfer overlap.
+
+``jnp.asarray`` inside the step loop serializes: the host blocks preparing
+and shipping batch N while the device idles, then the device computes while
+the host idles. ``DevicePrefetchIterator`` double-buffers instead — it
+issues the (optionally mesh-sharded) ``jax.device_put`` of batch N+1 before
+handing batch N to the caller, so the N+1 transfer rides alongside step N's
+compute. JAX transfers are asynchronous, so "issue" costs the host almost
+nothing.
+
+Composes with the host-side ``AsyncDataSetIterator`` (ETL on a background
+thread) — wrap Async around the raw iterator for host overlap, then this
+around Async for device overlap:
+
+    it = DevicePrefetchIterator(AsyncDataSetIterator(raw), mesh=mesh)
+
+Reference analogue: AsyncDataSetIterator.java covers only the host half;
+the device half did not exist because ND4J transfers were synchronous
+per-op, not per-batch.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+
+
+class DevicePrefetchIterator(DataSetIterator):
+    """Yield DataSets whose arrays are already resident on device.
+
+    ``mesh`` shards the batch axis over the mesh's 'data' axis (the layout
+    ParallelWrapper trains on — its own ``device_put`` then becomes a
+    no-op); without a mesh, arrays land on the default device. A batch that
+    does not divide the mesh's data axis passes through as host arrays
+    (the trainer's ragged-batch policy, drop or raise, stays in charge).
+
+    ``lookahead`` is the number of batches in flight beyond the one being
+    consumed; 1 (double buffering) is right unless transfers are much
+    shorter than steps AND the source is bursty.
+    """
+
+    def __init__(self, base, mesh=None, lookahead: int = 1):
+        self._base = base
+        self._mesh = mesh
+        self._lookahead = max(1, int(lookahead))
+        self.batches_prefetched = 0
+        self.batches_passed_through = 0
+
+    # ------------------------------------------------------------ placement
+    def _place_array(self, a):
+        if a is None:
+            return None
+        arr = jnp.asarray(a)
+        if self._mesh is not None:
+            from deeplearning4j_tpu.parallel.mesh import data_sharding
+            return jax.device_put(arr, data_sharding(self._mesh, arr.ndim))
+        return jax.device_put(arr)
+
+    def _place(self, ds: DataSet) -> DataSet:
+        if self._mesh is not None:
+            from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+            if ds.num_examples() % self._mesh.shape[DATA_AXIS]:
+                self.batches_passed_through += 1
+                return ds  # ragged: leave on host, trainer decides
+        self.batches_prefetched += 1
+        return DataSet(self._place_array(ds.features),
+                       self._place_array(ds.labels),
+                       self._place_array(ds.features_mask),
+                       self._place_array(ds.labels_mask))
+
+    # ------------------------------------------------------------- iteration
+    def _generate(self):
+        buf: deque = deque()
+        for ds in self._base:
+            # the base applies its OWN preprocessor while iterating; one set
+            # on this wrapper must also run — before device placement
+            if self.pre_processor is not None:
+                ds = self.pre_processor(ds)
+            buf.append(self._place(ds))
+            if len(buf) > self._lookahead:
+                yield buf.popleft()
+        while buf:
+            yield buf.popleft()
+
+    def __iter__(self):
+        # bypass DataSetIterator.__iter__'s reset plumbing: iterating the
+        # base runs its own reset (the preprocessor is handled in _generate)
+        return self._generate()
+
+    def reset(self):
+        if hasattr(self._base, "reset"):
+            self._base.reset()
+
+    def batch_size(self):
+        return self._base.batch_size() if hasattr(self._base, "batch_size") \
+            else None
+
+    def input_columns(self):
+        return self._base.input_columns() if hasattr(self._base,
+                                                     "input_columns") else None
+
+    def total_outcomes(self):
+        return self._base.total_outcomes() if hasattr(self._base,
+                                                      "total_outcomes") else None

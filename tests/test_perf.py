@@ -1,0 +1,380 @@
+"""perf/ subsystem: shape bucketing, device prefetch, compile observability.
+
+The contract under test is the TPU execution substrate's (PAPER.md): batch
+shapes must be STABLE — an epoch with a ragged tail is one compiled
+program, a serving mix of request sizes dispatches only pre-warmed bucket
+shapes, and host→device prefetch changes nothing numerically. The compile
+counters (perf/compile_watch.py) make all three assertable instead of
+inferred from wall clock.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import jax
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import (AsyncDataSetIterator,
+                                                   ListDataSetIterator)
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.updaters import Sgd
+from deeplearning4j_tpu.parallel import ParallelInference, ParallelWrapper
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+from deeplearning4j_tpu.perf import (BucketPolicy, DevicePrefetchIterator,
+                                     pad_dataset, pad_to_bucket, unpad)
+
+
+def _net(seed=7, lr=0.05, n_in=4, n_out=3):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Sgd(learning_rate=lr)).weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=n_out, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _ragged_batches(n=150, batch=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.random((n, 4), np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return DataSet(x, y).split(batch)  # e.g. 64, 64, 22
+
+
+# ----------------------------------------------------------- bucket policy
+def test_bucket_policy_rounding():
+    p = BucketPolicy(floor=8, cap=64)
+    assert [p.bucket(n) for n in (1, 7, 8, 9, 20, 32, 33, 64)] == \
+        [8, 8, 8, 16, 32, 32, 64, 64]
+    # above the cap: multiples of the cap, not powers of two
+    assert p.bucket(65) == 128 and p.bucket(129) == 192
+    assert p.buckets_up_to(32) == [8, 16, 32]
+    with pytest.raises(ValueError):
+        p.bucket(0)
+    with pytest.raises(ValueError):
+        BucketPolicy(floor=16, cap=8)
+
+
+def test_bucket_policy_explicit_ladder():
+    p = BucketPolicy(buckets=[4, 16])
+    assert [p.bucket(n) for n in (1, 4, 5, 16)] == [4, 4, 16, 16]
+    assert p.bucket(17) == 32 and p.bucket(33) == 48  # multiples of 16
+
+
+def test_bucket_policy_cap_is_never_overshot():
+    # a non-power-of-two cap is typically a memory budget: the pow2 ladder
+    # must clamp to it, not jump past it
+    p = BucketPolicy(floor=8, cap=1000)
+    assert p.bucket(600) == 1000
+    assert p.bucket(1000) == 1000
+    assert p.bucket(1001) == 2000  # above the cap: multiples of the cap
+
+
+def test_pad_unpad_roundtrip():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    padded = pad_to_bucket(x, 8)
+    assert padded.shape == (8, 4)
+    np.testing.assert_array_equal(padded[3:], 0)
+    np.testing.assert_array_equal(unpad(padded, 3), x)
+    assert pad_to_bucket(x, 3) is x  # no-op keeps identity
+    with pytest.raises(ValueError):
+        pad_to_bucket(x, 2)
+
+
+def test_pad_dataset_masks():
+    rng = np.random.default_rng(1)
+    ds = DataSet(rng.random((5, 4), np.float32),
+                 np.eye(3, dtype=np.float32)[rng.integers(0, 3, 5)])
+    padded = pad_dataset(ds, 8)
+    assert padded.num_examples() == 8
+    # fabricated labels mask: ones over real rows, zeros over padding
+    np.testing.assert_array_equal(padded.labels_mask,
+                                  [1, 1, 1, 1, 1, 0, 0, 0])
+    assert padded.features_mask is None
+    # sequence data: existing masks pad (fmask with ONES, lmask with zeros)
+    seq = DataSet(rng.random((2, 6, 4), np.float32),
+                  rng.random((2, 6, 3), np.float32),
+                  features_mask=np.ones((2, 6), np.float32),
+                  labels_mask=np.ones((2, 6), np.float32))
+    pseq = pad_dataset(seq, 4)
+    np.testing.assert_array_equal(pseq.features_mask[2:], 1.0)
+    np.testing.assert_array_equal(pseq.labels_mask[2:], 0.0)
+    # sequence OUTPUT without lmask: the fmask stands in (zero-padded)
+    seq2 = DataSet(rng.random((2, 6, 4), np.float32),
+                   rng.random((2, 6, 3), np.float32),
+                   features_mask=np.ones((2, 6), np.float32))
+    assert pad_dataset(seq2, 4).labels_mask.shape == (4, 6)
+    # masked-sequence INPUT with 2-D labels (pooled classifier): the
+    # fabricated lmask must match the per-example score shape (batch,),
+    # NOT the (batch, T) features mask
+    clf = DataSet(rng.random((2, 6, 4), np.float32),
+                  np.eye(3, dtype=np.float32)[[0, 1]],
+                  features_mask=np.ones((2, 6), np.float32))
+    pclf = pad_dataset(clf, 4)
+    assert pclf.labels_mask.shape == (4,)
+    np.testing.assert_array_equal(pclf.labels_mask, [1, 1, 0, 0])
+
+
+# ------------------------------------------------- shape-stable training
+def test_ragged_epoch_single_compile_and_exact_numerics(devices):
+    """Acceptance (a): a ragged final batch neither recompiles the train
+    step nor changes the training math — the padded rows are masked out of
+    the loss with the correct denominator."""
+    batches = _ragged_batches()
+    assert [b.num_examples() for b in batches] == [64, 64, 22]
+
+    plain = _net(seed=7)
+    plain.fit(batches, num_epochs=3)
+
+    bucketed = _net(seed=7)
+    bucketed.fit(batches, num_epochs=3, bucket_policy=True)
+
+    assert bucketed.compile_watch.compiles("train") == 1, \
+        bucketed.compile_watch.as_dict()
+    assert bucketed.compile_watch.dispatches("train") == 9
+    # the unbucketed run compiled twice: once for 64 rows, once for 22
+    assert plain.compile_watch.compiles("train") == 2
+    for a, b in zip(jax.tree_util.tree_leaves(plain.params),
+                    jax.tree_util.tree_leaves(bucketed.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_fit_fused_bucketed_ragged_group(devices):
+    """fit_fused accepts a ragged DataSet list under a bucket policy: the
+    whole group runs as one scan program and matches sequential fit()."""
+    batches = _ragged_batches()
+    seq = _net(seed=3)
+    seq.fit(batches, bucket_policy=True)
+
+    fused = _net(seed=3)
+    fused.fit_fused(batches, bucket_policy=True)
+    assert fused.compile_watch.compiles() == 1
+    assert fused.compile_watch.dispatches() == 1
+    for a, b in zip(jax.tree_util.tree_leaves(seq.params),
+                    jax.tree_util.tree_leaves(fused.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+
+
+# -------------------------------------------------- shape-stable serving
+def test_bucketed_serving_dispatches_only_warmed_buckets(devices):
+    """Acceptance (b): with warmed buckets, a serving run over request
+    sizes {1, 3, 7, 20} triggers ZERO compiles and zero un-warmed
+    dispatches, and every caller still gets its exact slice."""
+    net = _net(seed=9)
+    pi = ParallelInference(net, mesh=make_mesh(), batch_limit=16,
+                           queue_timeout_ms=30)
+    sizes = (1, 3, 7, 20)
+    # worst case the worker coalesces all four requests: 31 rows -> 32
+    warmed = pi.warmup(np.zeros((1, 4), np.float32), buckets=[8, 16, 32])
+    assert warmed == [8, 16, 32]
+    compiles_after_warmup = net.compile_watch.compiles()
+
+    rng = np.random.default_rng(2)
+    inputs = {n: rng.random((n, 4), np.float32) for n in sizes}
+    outs = {}
+
+    def worker(n):
+        outs[n] = pi.output_batched(inputs[n])
+
+    threads = [threading.Thread(target=worker, args=(n,)) for n in sizes]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    pi.shutdown()
+
+    # compile check FIRST: the verification net.output() calls below use
+    # raw (unbucketed) shapes and would legitimately compile
+    assert pi.unwarmed_dispatches == 0, pi.stats()
+    assert net.compile_watch.compiles() == compiles_after_warmup
+    for n in sizes:
+        assert outs[n].shape == (n, 3)
+        np.testing.assert_allclose(outs[n], net.output(inputs[n]),
+                                   rtol=1e-5, atol=1e-6)
+    # every dispatch shape is on the warmed ladder
+    assert set(pi.bucket_dispatches) <= set(warmed)
+
+    st = pi.stats()
+    assert st["batch_size"]["count"] == st["batches_dispatched"]
+
+
+def test_warmup_warms_the_exact_live_dispatch_shape(devices):
+    """Warmup must dispatch EXACTLY the shape live traffic will dispatch,
+    even when the dp-rounded target is not a fixed point of the policy
+    (e.g. explicit bucket 6 on a dp=8 mesh: live size-6 requests dispatch
+    at 8, and re-bucketing 8 would have compiled 16 instead)."""
+    net = _net(seed=21)
+    pi = ParallelInference(net, mesh=make_mesh(),
+                           bucket_policy=BucketPolicy(buckets=[6]))
+    assert pi._pad_target(6) == 8          # 6 -> bucket 6 -> dp multiple 8
+    assert pi._pad_target(8) != 8          # 8 is NOT a policy fixed point
+    warmed = pi.warmup(np.zeros((1, 4), np.float32), buckets=[6])
+    assert warmed == [8]
+    compiles_after = net.compile_watch.compiles()
+    out = pi.output(np.random.default_rng(0).random((6, 4), np.float32))
+    assert out.shape == (6, 3)
+    assert pi.unwarmed_dispatches == 0, pi.stats()
+    assert net.compile_watch.compiles() == compiles_after
+
+
+def test_ones_mask_cache_is_reused_and_readonly():
+    from deeplearning4j_tpu.perf.bucketing import _ones_like_mask
+    a = _ones_like_mask((), 5, 8)
+    b = _ones_like_mask((), 5, 8)
+    assert a is b  # fabricated every batch of every epoch: must be cached
+    with pytest.raises(ValueError):
+        a[0] = 0.0
+
+
+def test_sequential_output_path_buckets_too(devices):
+    """Satellite: the synchronous output() path rounds up to the bucket
+    ladder (it used to pad only to a data-axis multiple — one compiled
+    program per distinct size)."""
+    net = _net(seed=5)
+    pi = ParallelInference(net, mesh=make_mesh())
+    rng = np.random.default_rng(3)
+    for n in (3, 5, 7):  # all land in the floor bucket (8)
+        out = pi.output(rng.random((n, 4), np.float32))
+        assert out.shape == (n, 3)
+    assert set(pi.bucket_dispatches) == {8}
+    # a zero-row request must not poison the dispatch (regression: the
+    # bucket ladder rejects n < 1; empty batches bypass it)
+    assert pi.output(np.zeros((0, 4), np.float32)).shape == (0, 3)
+    # disabling the policy restores pad-to-axis behaviour
+    pi_raw = ParallelInference(net, mesh=make_mesh(), bucket_policy=None)
+    assert pi_raw._pad_target(3) == 8 and pi_raw._pad_target(9) == 16
+
+
+def test_batch_size_history_is_bounded(devices):
+    """Satellite: batch_sizes must not grow without bound under sustained
+    serving."""
+    net = _net(seed=6)
+    pi = ParallelInference(net, batch_size_history=4, queue_timeout_ms=1)
+    x = np.zeros((2, 4), np.float32)
+    for _ in range(7):
+        pi.output_batched(x)
+    assert len(pi.batch_sizes) <= 4
+    assert pi.batches_dispatched == 7  # totals still exact
+    st = pi.stats()
+    assert st["batch_size"]["count"] <= 4 and st["batch_size"]["max"] >= 1
+    pi.shutdown()
+
+
+# -------------------------------------------------------- device prefetch
+def test_device_prefetch_bitwise_identical(devices):
+    """Acceptance (c): DevicePrefetchIterator changes WHERE arrays live,
+    never their values — training through it is bitwise identical on CPU."""
+    batches = _ragged_batches(n=128, batch=32)
+
+    plain = _net(seed=11)
+    plain.fit(batches, num_epochs=2)
+
+    prefetched = _net(seed=11)
+    prefetched.fit(batches, num_epochs=2, prefetch=True)
+
+    for a, b in zip(jax.tree_util.tree_leaves(plain.params),
+                    jax.tree_util.tree_leaves(prefetched.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_device_prefetch_yields_device_arrays_and_composes(devices):
+    batches = _ragged_batches(n=96, batch=32)
+    base = ListDataSetIterator(batches, 32)
+    it = DevicePrefetchIterator(AsyncDataSetIterator(base, queue_size=2))
+    seen = list(it)
+    assert len(seen) == 3
+    for got, want in zip(seen, batches):
+        assert isinstance(got.features, jax.Array)
+        np.testing.assert_array_equal(np.asarray(got.features), want.features)
+    # re-iterable: a second pass yields the same stream
+    assert len(list(it)) == 3
+    assert it.batches_prefetched == 6
+
+
+def test_device_prefetch_mesh_sharding_and_ragged_passthrough(devices):
+    mesh = make_mesh()
+    batches = _ragged_batches(n=150, batch=64)  # 64, 64, 22 (ragged tail)
+    it = DevicePrefetchIterator(batches, mesh=mesh)
+    seen = list(it)
+    assert len(seen[0].features.sharding.device_set) == 8
+    # the ragged tail passes through as a host array for the trainer to judge
+    assert isinstance(seen[-1].features, np.ndarray)
+    assert it.batches_prefetched == 2 and it.batches_passed_through == 1
+
+
+def test_parallel_wrapper_prefetch_matches_and_reports_compiles(devices):
+    ds = _ragged_batches(n=144, batch=48)  # 48x3, all shardable over dp=8
+    a = _net(seed=13)
+    ParallelWrapper(a, mesh=make_mesh()).fit(ds, num_epochs=2)
+
+    b = _net(seed=13)
+    pw = ParallelWrapper(b, mesh=make_mesh(), collect_stats=True)
+    pw.fit(ds, num_epochs=2, prefetch=True)
+
+    for la, lb in zip(jax.tree_util.tree_leaves(a.params),
+                      jax.tree_util.tree_leaves(b.params)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=1e-6, atol=1e-7)
+    st = pw.stats.as_dict()
+    assert st["counters"]["model_compiles"] == 1
+    assert st["counters"]["model_dispatches"] == 6
+    assert "model_compiles" in pw.stats.to_string()
+
+
+def test_cluster_trainer_fit_accepts_prefetch_kwarg(devices):
+    """Signature parity: ClusterTrainer.fit must accept prefetch= (no-op
+    under multi-host batch assembly, but it must not TypeError)."""
+    from deeplearning4j_tpu.parallel import ClusterTrainer
+    net = _net(seed=17)
+    ds = _ragged_batches(n=96, batch=48)
+    ClusterTrainer(net, mesh=make_mesh()).fit(ds, num_epochs=1, prefetch=True)
+    assert net.score() is not None
+
+
+# ------------------------------------------------------------ stats plumbing
+def test_training_stats_counters():
+    from deeplearning4j_tpu.parallel.stats import TrainingStats
+    st = TrainingStats()
+    st.set_counter("model_compiles", 3)
+    st.inc_counter("model_compiles")
+    st.inc_counter("widgets", 2)
+    d = st.as_dict()
+    assert d["counters"] == {"model_compiles": 4, "widgets": 2}
+    assert "widgets" in st.to_string()
+
+
+# --------------------------------------------------------------- bench smoke
+def test_bench_quick_smoke():
+    """CI tripwire: bench.py runs end-to-end (BENCH_ONLY=lenet,serving —
+    the two benches exercising prefetch and bucketing) and the serving
+    line carries the batch-size summary + compile counters the acceptance
+    criteria require."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, BENCH_QUICK="1", BENCH_ONLY="lenet,serving",
+               JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # single-device run, no 8-way host mesh
+    proc = subprocess.run([sys.executable, "bench.py"], cwd=repo, env=env,
+                          capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+    by_metric = {l["metric"]: l for l in lines}
+    assert not any("error" in l for l in lines), lines
+    assert "lenet_mnist_train_imgs_per_sec_per_chip_plain_fit" in by_metric
+    serving = by_metric["parallel_inference_serving_reqs_per_sec"]
+    assert serving["value"] > 0
+    assert {"p50_ms", "p99_ms", "batches_dispatched", "batch_size",
+            "compiles", "unwarmed_dispatches"} <= set(serving)
+    assert serving["batch_size"]["count"] == serving["batches_dispatched"]
+    # the shape-stability contract: traffic after warmup compiles nothing
+    assert serving["compiles"] == serving["compiles_after_warmup"], serving
+    assert serving["unwarmed_dispatches"] == 0
