@@ -8,6 +8,8 @@ Covers BASELINE.json configs[0]-[3] plus the serving microbench:
   4. ParallelInference serving (concurrent clients, mixed request sizes)
                                                  -> req/sec + p50/p99 latency,
                                                     batch-size summary, compiles
+  5. Checkpoint overhead (checkpoint/ subsystem) -> steps/sec off vs async
+                                                    vs sync save_every_n_steps
 
 The reference repo publishes no numbers (BASELINE.md); each ``vs_baseline``
 is reported against a fixed nominal V100-era denominator so the ratio is
@@ -51,6 +53,7 @@ NOMINAL = {
     "charlstm": 100_000.0,  # chars/sec, cuDNN LSTM char-RNN
     "word2vec": 500_000.0,  # words/sec, multithreaded host SGNS
     "serving": 10_000.0,    # req/sec, nominal GPU dynamic-batching server
+    "checkpoint": 1_000.0,  # steps/sec, nominal small-model step loop
 }
 
 
@@ -418,9 +421,104 @@ def bench_serving():
               "must hold (shape-stability tripwire). " % sizes + _REPS_NOTE)
 
 
+def bench_checkpoint():
+    """Checkpoint-overhead microbench: steps/sec for the same small-MLP
+    train loop with checkpointing OFF, ASYNC every N steps (checkpoint/
+    contract: snapshot on the training thread, write on a worker — the
+    step loop must not pay for disk) and SYNC every N steps (the cost the
+    async path hides). The acceptance bar is overhead_async_pct < 10 at
+    save_every_n_steps=10."""
+    import shutil
+    import tempfile
+
+    from deeplearning4j_tpu.checkpoint import CheckpointManager
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+
+    # sized so the 10-step save interval (~150 ms at ~15 ms/step on CPU;
+    # comparable for a real model on TPU) comfortably covers one atomic
+    # commit (~15-20 ms for the ~0.3 MB payload on this host's 9p fs) —
+    # the async path then hides the whole write. A model so small that
+    # steps outrun the disk would instead measure the bounded queue's
+    # BACKPRESSURE (by design: snapshots must not accumulate unboundedly
+    # in host RAM), and on this CPU-only host the writer additionally
+    # steals XLA compute cores, which a TPU deployment does not pay.
+    steps = 40 if QUICK else 200
+    batch, hidden = 2048, 256
+    every = 10
+    n_features, n_classes = 256, 10
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((batch, n_features)).astype(np.float32)
+    y = np.eye(n_classes, dtype=np.float32)[rng.integers(0, n_classes, batch)]
+    batches = [DataSet(x, y)] * steps  # one resident batch, `steps` steps
+
+    def make_net():
+        conf = (NeuralNetConfiguration.builder()
+                .seed(11).updater(Sgd(learning_rate=0.01))
+                .weight_init("xavier").list()
+                .layer(DenseLayer(n_out=hidden, activation="relu"))
+                .layer(OutputLayer(n_out=n_classes, loss="mcxent"))
+                .set_input_type(InputType.feed_forward(n_features))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def steps_per_sec(cm):
+        net = make_net()
+        net.fit(batches[0])      # compile + warmup
+        float(net._score)
+
+        def timed():
+            t0 = time.perf_counter()
+            net.fit(batches, checkpoint_manager=cm)
+            float(net._score)    # VALUE fetch forces the whole chain
+            return time.perf_counter() - t0
+
+        return steps / _best_of(timed)
+
+    tmp = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        sps_off = steps_per_sec(None)
+        cm_async = CheckpointManager(os.path.join(tmp, "async"),
+                                     save_every_n_steps=every, keep_last=3,
+                                     async_write=True)
+        sps_async = steps_per_sec(cm_async)
+        cm_async.flush()
+        written = cm_async.saves_committed
+        retained = cm_async.checkpoints()
+        ckpt_bytes = retained[-1]["size"] if retained else 0
+        cm_async.close()
+        cm_sync = CheckpointManager(os.path.join(tmp, "sync"),
+                                    save_every_n_steps=every, keep_last=3,
+                                    async_write=False)
+        sps_sync = steps_per_sec(cm_sync)
+        cm_sync.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    def overhead(sps):
+        return round((sps_off - sps) / sps_off * 100, 1)
+
+    emit("checkpoint_async_train_steps_per_sec", sps_async, "steps/sec",
+         "checkpoint",
+         steps=steps, save_every_n_steps=every,
+         steps_per_sec_off=round(sps_off, 1),
+         steps_per_sec_sync=round(sps_sync, 1),
+         overhead_async_pct=overhead(sps_async),
+         overhead_sync_pct=overhead(sps_sync),
+         checkpoints_written=written, checkpoints_retained=len(retained),
+         ckpt_bytes=ckpt_bytes,
+         note="same train loop, checkpointing off vs async vs sync every "
+              f"{every} steps (snapshot+atomic journaled commit each save); "
+              "acceptance: overhead_async_pct < 10. " + _REPS_NOTE)
+
+
 def main():
     benches = [("lenet", bench_lenet), ("word2vec", bench_word2vec),
                ("charlstm", bench_graveslstm), ("serving", bench_serving),
+               ("checkpoint", bench_checkpoint),
                ("resnet50", bench_resnet50)]
     only = os.environ.get("BENCH_ONLY")
     if only:

@@ -30,3 +30,4 @@ from deeplearning4j_tpu.perf import (  # noqa: F401
     BucketPolicy,
     DevicePrefetchIterator,
 )
+from deeplearning4j_tpu.checkpoint import CheckpointManager  # noqa: F401

@@ -1,0 +1,37 @@
+"""checkpoint/ — asynchronous, crash-consistent checkpointing with
+exact-step resume.
+
+Three cooperating pieces (see each module's docstring):
+
+- ``manager``  — CheckpointManager: host snapshot on the training thread,
+                 async atomic journaled commits, retention, triggers,
+                 multi-host barrier, ``restore_latest``/``restore_best``
+                 with fall-back past torn files, early-stopping saver
+                 protocol;
+- ``manifest`` — the checksummed journal + tmp/fsync/rename commit
+                 primitives that make a torn write detectable;
+- ``faults``   — FaultInjector / tear_file / flip_byte: the crash and
+                 corruption simulators the resume-bitwise tests drive.
+
+Wired end-to-end as ``fit(..., checkpoint_manager=cm)`` on
+MultiLayerNetwork, ComputationGraph, ParallelWrapper and ClusterTrainer.
+"""
+
+from deeplearning4j_tpu.checkpoint.manager import (  # noqa: F401
+    CheckpointError,
+    CheckpointManager,
+    ResumeState,
+    consume_resume_state,
+)
+from deeplearning4j_tpu.checkpoint.faults import (  # noqa: F401
+    FaultInjector,
+    SimulatedCrash,
+    flip_byte,
+    tear_file,
+)
+from deeplearning4j_tpu.checkpoint.manifest import (  # noqa: F401
+    ManifestError,
+    file_sha256,
+    load_manifest,
+    scan_checkpoint_files,
+)

@@ -1,0 +1,76 @@
+"""Fault injection for checkpoint/resume testing.
+
+``FaultInjector`` is a training listener that kills the run at a chosen
+optimizer step — after the step's parameter update, before the next batch —
+which is exactly where a preemption lands from the training loop's point of
+view. Tests drive it to prove the subsystem's core claim: crash at an
+ARBITRARY step + ``restore_latest()`` + resumed ``fit`` produces final
+params bitwise-identical to the uninterrupted run.
+
+``tear_file`` / ``flip_byte`` simulate the disk-level failure modes the
+manifest layer must detect: a write torn by a crash (truncation) and silent
+bit rot (flip) — both must make ``restore_latest`` fall back, never restore
+garbage.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class SimulatedCrash(RuntimeError):
+    """Raised by FaultInjector to simulate a preemption/crash mid-training."""
+
+
+class FaultInjector:
+    """Listener that raises :class:`SimulatedCrash` once ``kill_at_step``
+    optimizer steps have completed. Attach with ``model.set_listeners`` (or
+    alongside real listeners via ``add_listener``)::
+
+        net.set_listeners(FaultInjector(kill_at_step=7))
+        with pytest.raises(SimulatedCrash):
+            net.fit(data, num_epochs=3, checkpoint_manager=cm)
+    """
+
+    def __init__(self, kill_at_step: int):
+        if kill_at_step < 1:
+            raise ValueError("kill_at_step must be >= 1")
+        self.kill_at_step = int(kill_at_step)
+        self.fired = False
+
+    def iteration_done(self, model, iteration, epoch):
+        # ``iteration`` is the model's pre-increment counter: after the k-th
+        # optimizer step it reads k-1, so the crash lands exactly when
+        # kill_at_step steps have fully applied their updates
+        if iteration + 1 >= self.kill_at_step:
+            self.fired = True
+            raise SimulatedCrash(
+                f"fault injection: killed training after step {iteration + 1}")
+
+    def on_epoch_start(self, model):
+        pass
+
+    def on_epoch_end(self, model):
+        pass
+
+
+def tear_file(path: str, keep_fraction: float = 0.5) -> int:
+    """Truncate ``path`` to ``keep_fraction`` of its bytes — a torn write.
+    Returns the new size."""
+    size = os.path.getsize(path)
+    keep = max(0, int(size * keep_fraction))
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+    return keep
+
+
+def flip_byte(path: str, offset: int = -1):
+    """XOR one byte (default: the last) — silent corruption that leaves the
+    file size intact, so only a checksum can catch it."""
+    size = os.path.getsize(path)
+    pos = offset % size
+    with open(path, "r+b") as f:
+        f.seek(pos)
+        b = f.read(1)
+        f.seek(pos)
+        f.write(bytes([b[0] ^ 0xFF]))

@@ -1,0 +1,547 @@
+"""Asynchronous, crash-consistent checkpointing with exact-step resume.
+
+``CheckpointManager`` turns the repo's existing ingredients — the zip model
+format (utils/serialization.py), host snapshots safe under buffer donation
+(earlystopping/savers.py's ``device_get`` discipline) and the watchdog's
+bounded-deadline pattern (parallel/watchdog.py) — into durable, low-overhead,
+resumable training:
+
+- **Snapshot on the training thread, write on a worker thread.** ``save``
+  copies params + updater state + PRNG key + step/epoch counters to host
+  (``jax.device_get`` — safe w.r.t. ``donate_argnums``) and returns; a
+  bounded queue hands the snapshot to a writer thread, so the step loop
+  never blocks on disk. ``async_write=False`` degrades to synchronous
+  commits (deterministic tests, worst-case-overhead benching).
+- **Atomic, journaled commits.** Bytes go to ``tmp/`` + fsync + rename,
+  then the entry (with the file's sha256) is journaled into a checksummed
+  ``manifest.json`` (checkpoint/manifest.py). A torn write is detected, and
+  ``restore_latest`` falls back to the last complete checkpoint.
+- **Retention.** ``keep_last=N`` bounds disk; ``keep_best`` ("min"/"max"
+  over the ``metric`` passed to ``save``) pins the best checkpoint outside
+  that window.
+- **Triggers.** ``save_every_n_steps`` / ``save_every_secs`` are evaluated
+  by ``step_end``, which ``fit(..., checkpoint_manager=)`` calls after
+  every optimizer step on MultiLayerNetwork, ComputationGraph,
+  ParallelWrapper and ClusterTrainer.
+- **Exact-step resume.** A checkpoint records ``batch_in_epoch``; the model
+  ``restore_latest`` returns carries a :class:`ResumeState`, and the next
+  ``fit`` treats ``num_epochs`` as the run's TOTAL target — it skips the
+  already-consumed batches of the interrupted epoch and continues the
+  restored rng split chain, so resume is BITWISE-identical to the
+  uninterrupted run (asserted in tests/test_checkpoint.py via
+  checkpoint/faults.py's FaultInjector).
+- **Multi-host.** Only process 0 writes; every ``save`` point is a
+  collective barrier bounded by a ``CollectiveWatchdog`` deadline, so a
+  dead peer surfaces as a diagnostic timeout instead of a silent hang.
+  (Params must be process-0 addressable — replicated or single-host
+  sharded; multi-host tensor-parallel checkpointing would need a gather
+  and is out of scope here.)
+
+The manager also implements the early-stopping saver protocol
+(``save_best_model`` / ``save_latest_model`` / ``get_best_model``), so it
+drops in as ``EarlyStoppingConfiguration.model_saver`` — best models become
+durable, checksummed checkpoints instead of bare zips.
+
+Reference analogue: CheckpointListener.java (periodic in-place saves, no
+journal, no atomicity, no resume-to-exact-step) — superseded here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import logging
+import os
+import queue
+import threading
+import time
+from typing import List, Optional
+
+log = logging.getLogger(__name__)
+
+
+class CheckpointError(RuntimeError):
+    """A background write failed; re-raised on the training thread at the
+    next save/flush/close so errors are never silently swallowed."""
+
+
+@dataclasses.dataclass
+class ResumeState:
+    """Where a restored model stopped. ``fit`` consumes this marker: it
+    runs epochs ``epoch .. num_epochs-1`` and skips the first
+    ``batch_in_epoch`` batches of the resumed epoch."""
+    step: int
+    epoch: int
+    batch_in_epoch: int
+    path: str
+
+
+class CheckpointManager:
+    """See module docstring. Typical use::
+
+        cm = CheckpointManager("ckpts", save_every_n_steps=100, keep_last=3)
+        net.fit(data, num_epochs=10, checkpoint_manager=cm)
+        ...                                  # preemption / crash
+        cm = CheckpointManager("ckpts")      # fresh process
+        net = cm.restore_latest()            # falls back past torn files
+        net.fit(data, num_epochs=10, checkpoint_manager=cm)  # exact resume
+    """
+
+    def __init__(self, directory: str,
+                 save_every_n_steps: Optional[int] = None,
+                 save_every_secs: Optional[float] = None,
+                 keep_last: Optional[int] = None,
+                 keep_best: Optional[str] = None,
+                 async_write: bool = True,
+                 queue_depth: int = 2,
+                 barrier_timeout_s: float = 300.0,
+                 save_updater: bool = True):
+        if save_every_n_steps is not None and save_every_n_steps < 1:
+            raise ValueError("save_every_n_steps must be >= 1")
+        if keep_best not in (None, "min", "max"):
+            raise ValueError("keep_best must be None, 'min' or 'max'")
+        if keep_last is not None and keep_last < 1:
+            raise ValueError("keep_last must be >= 1")
+        self.directory = str(directory)
+        self.save_every_n_steps = save_every_n_steps
+        self.save_every_secs = save_every_secs
+        self.keep_last = keep_last
+        self.keep_best = keep_best
+        self.async_write = bool(async_write)
+        self.barrier_timeout_s = float(barrier_timeout_s)
+        self.save_updater = bool(save_updater)
+        from deeplearning4j_tpu.checkpoint import manifest as mf
+        self._mf = mf
+        os.makedirs(self.directory, exist_ok=True)
+        mf.clean_tmp(self.directory)  # orphans from a crash mid-write
+        self._lock = threading.Lock()          # guards _entries + manifest
+        try:
+            entries = mf.load_manifest(self.directory)
+        except mf.ManifestError as e:
+            log.warning("%s — rebuilding from directory scan", e)
+            entries = None
+        if entries is None and mf.scan_checkpoint_files(self.directory):
+            # torn OR missing manifest over surviving checkpoint files:
+            # rebuild the journal — sha recomputed AND the per-entry
+            # metadata (step/metric/...) read back out of each zip, so
+            # restore_best / retention / checkpoints() keep working after
+            # the rebuild, not just restore_latest
+            entries = []
+            for e_ in mf.scan_checkpoint_files(self.directory):
+                path = os.path.join(self.directory, e_["file"])
+                rebuilt = self._entry_from_file(path, e_["file"])
+                if rebuilt is not None:
+                    entries.append(rebuilt)
+            mf.write_manifest(self.directory, entries)
+        self._entries: List[dict] = entries or []
+        self._seq = max((int(e.get("seq", 0)) for e in self._entries),
+                        default=0)
+        self._batch_in_epoch = 0
+        self._last_save_t = time.monotonic()
+        # step-trigger watermark: resumes the cadence from the last
+        # committed checkpoint when re-opening an existing directory
+        self._last_save_step = (int(self._entries[-1].get("step", 0))
+                                if self._entries else 0)
+        self._q: Optional[queue.Queue] = None
+        self._worker: Optional[threading.Thread] = None
+        self._queue_depth = max(1, int(queue_depth))
+        self._write_err: Optional[BaseException] = None
+        self.saves_requested = 0
+        self.saves_committed = 0
+
+    @staticmethod
+    def _entry_from_file(path: str, filename: str) -> Optional[dict]:
+        """Reconstruct a full journal entry from a checkpoint zip's own
+        metadata (manifest-rebuild path); None if the file is unreadable."""
+        import json
+        import zipfile
+        from deeplearning4j_tpu.checkpoint import manifest as mf
+        try:
+            sha = mf.file_sha256(path)
+            with zipfile.ZipFile(path, "r") as z:
+                meta = json.loads(z.read("metadata.json"))
+            return {
+                "file": filename,
+                "seq": int(meta.get("seq", 0)),
+                "step": int(meta.get("iteration", 0)),
+                "epoch": int(meta.get("epoch", 0)),
+                "batch_in_epoch": int(meta.get("batch_in_epoch", 0)),
+                "metric": meta.get("metric"),
+                "wall_time": meta.get("wall_time"),
+                "sha256": sha,
+                "size": os.path.getsize(path),
+            }
+        except Exception as e:
+            log.warning("skipping unreadable checkpoint %s during manifest "
+                        "rebuild (%s: %s)", filename, type(e).__name__, e)
+            return None
+
+    # --------------------------------------------------------------- triggers
+    def _secs_trigger_due(self) -> bool:
+        """The wall-clock trigger — SINGLE-HOST ONLY: it reads the local
+        monotonic clock, which drifts across hosts, and a save on one host
+        but not its peers desyncs the barrier count and times out the
+        fleet. Multi-host jobs must use save_every_n_steps (driven by the
+        identical iteration counter everywhere)."""
+        import jax
+        if jax.process_count() > 1:
+            raise ValueError(
+                "save_every_secs is single-host only: local clocks drift "
+                "across processes, so the time trigger would fire on some "
+                "hosts and not others and desync the checkpoint barrier — "
+                "use save_every_n_steps for multi-host jobs")
+        return (time.monotonic() - self._last_save_t) >= self.save_every_secs
+
+    def step_end(self, model, batch_in_epoch: Optional[int] = None):
+        """Called by ``fit`` after every optimizer step (``model.iteration``
+        already incremented). ``batch_in_epoch`` is the number of batches
+        consumed so far in the CURRENT epoch — what exact-step resume skips."""
+        if batch_in_epoch is not None:
+            self._batch_in_epoch = int(batch_in_epoch)
+        n = self.save_every_n_steps
+        # threshold, not exact modulo: tbptt batches advance iteration by
+        # SEVERAL windows per step_end, so `iteration % n == 0` would fire
+        # only at lcm(windows, n) — or never — instead of every ~n steps
+        due = bool(n) and (model.iteration - self._last_save_step) >= n
+        if not due and self.save_every_secs is not None:
+            due = self._secs_trigger_due()
+        if due:
+            self.save(model)
+
+    def epoch_end(self, model):
+        """Epoch boundary: resume state resets to batch 0 of the (already
+        incremented) next epoch; the time trigger may still fire."""
+        self._batch_in_epoch = 0
+        if self.save_every_secs is not None and self._secs_trigger_due():
+            self.save(model)
+
+    # ------------------------------------------------------------------- save
+    def save(self, model, metric: Optional[float] = None,
+             wait: bool = False) -> Optional[str]:
+        """Snapshot ``model`` and commit it (async by default). Returns the
+        checkpoint filename on the writer process, ``None`` on non-writers.
+        ``metric`` (lower/higher better per ``keep_best``) feeds best-model
+        retention and ``restore_best``."""
+        import jax
+        self._raise_pending()
+        # reset BOTH trigger watermarks on EVERY process (a non-writer
+        # whose watermarks never advanced would re-trigger each step and
+        # desync the barrier count across hosts). Note the secs trigger
+        # reads local clocks — multi-host jobs should prefer
+        # save_every_n_steps, which is driven by the identical iteration
+        # counter on every host.
+        self._last_save_t = time.monotonic()
+        self._last_save_step = int(model.iteration)
+        multi = jax.process_count() > 1
+        if multi and jax.process_index() != 0:
+            # non-writers only barrier: keeps every host's save points in
+            # lockstep so process 0's device_get sync can't skew the step
+            # cadence across the fleet
+            self._barrier("checkpoint save")
+            return None
+        from deeplearning4j_tpu.utils.serialization import snapshot_training_state
+        snap = snapshot_training_state(model)
+        if not self.save_updater:
+            snap["opt_state"] = None
+        self._seq += 1
+        extra = {
+            "seq": self._seq,
+            "batch_in_epoch": self._batch_in_epoch,
+            "wall_time": time.time(),
+            "metric": None if metric is None else float(metric),
+        }
+        filename = f"ckpt-{snap['iteration']:010d}-{self._seq:05d}.zip"
+        self.saves_requested += 1
+        if self.async_write:
+            self._ensure_worker()
+            self._q.put((snap, extra, filename))  # bounded: backpressure,
+            # a slow disk can't accumulate unbounded host snapshots
+        else:
+            self._write_and_commit(snap, extra, filename)
+        if multi:
+            self._barrier("checkpoint save")
+        if wait:
+            self.flush()
+        return filename
+
+    # ------------------------------------------------------ saver protocol
+    # (duck-typed EarlyStoppingConfiguration.model_saver backend)
+    def save_best_model(self, model, score):
+        if self.keep_best is None and self.keep_last is not None:
+            # saver contract: get_best_model must return the BEST model,
+            # so the best checkpoint must be pinned outside the keep_last
+            # window (early stopping minimizes its score → "min")
+            log.info("CheckpointManager used as early-stopping saver with "
+                     "keep_last but no keep_best — defaulting keep_best="
+                     "'min' so retention cannot prune the best checkpoint")
+            self.keep_best = "min"
+        self.save(model, metric=score)
+
+    def save_latest_model(self, model, score):
+        self.save(model, metric=score)
+
+    def get_best_model(self, template=None):
+        return self.restore_best()
+
+    # ------------------------------------------------------------ worker side
+    def _ensure_worker(self):
+        if self._worker is not None and self._worker.is_alive():
+            return
+        self._q = queue.Queue(maxsize=self._queue_depth)
+        self._worker = threading.Thread(target=self._worker_loop,
+                                        name="checkpoint-writer", daemon=True)
+        self._worker.start()
+
+    _SENTINEL = object()
+
+    def _worker_loop(self):
+        while True:
+            item = self._q.get()
+            try:
+                if item is CheckpointManager._SENTINEL:
+                    return
+                snap, extra, filename = item
+                try:
+                    self._write_and_commit(snap, extra, filename)
+                except BaseException as e:  # surfaced on the training thread
+                    log.exception("checkpoint write failed for %s", filename)
+                    self._write_err = e
+            finally:
+                self._q.task_done()
+
+    def _write_and_commit(self, snap: dict, extra: dict, filename: str):
+        from deeplearning4j_tpu.utils.serialization import checkpoint_zip_bytes
+        data = checkpoint_zip_bytes(snap, extra)
+        sha = hashlib.sha256(data).hexdigest()
+        # fsync_directory deferred to the manifest write below (same dir):
+        # the journal entry can never become durable before the payload
+        self._mf.atomic_write_bytes(self.directory, filename, data,
+                                    fsync_directory=False)
+        entry = {
+            "file": filename,
+            "seq": extra["seq"],
+            "step": snap["iteration"],
+            "epoch": snap["epoch"],
+            "batch_in_epoch": extra["batch_in_epoch"],
+            "metric": extra["metric"],
+            "wall_time": extra["wall_time"],
+            "sha256": sha,
+            "size": len(data),
+        }
+        with self._lock:
+            self._entries.append(entry)
+            self._entries = self._apply_retention(self._entries)
+            self._mf.write_manifest(self.directory, self._entries)
+        self.saves_committed += 1
+
+    def _best_entry(self, entries: List[dict],
+                    direction: Optional[str] = None) -> Optional[dict]:
+        direction = direction or self.keep_best or "min"
+        scored = [e for e in entries if e.get("metric") is not None]
+        if not scored:
+            return None
+        key = (lambda e: e["metric"])
+        return (min if direction == "min" else max)(scored, key=key)
+
+    def _apply_retention(self, entries: List[dict]) -> List[dict]:
+        if self.keep_last is None or len(entries) <= self.keep_last:
+            return entries
+        keep = set(id(e) for e in entries[-self.keep_last:])
+        if self.keep_best:
+            best = self._best_entry(entries)
+            if best is not None:
+                keep.add(id(best))
+        kept, pruned = [], []
+        for e in entries:
+            (kept if id(e) in keep else pruned).append(e)
+        for e in pruned:
+            try:
+                os.remove(os.path.join(self.directory, e["file"]))
+            except OSError:
+                pass  # retention is best-effort; the manifest is truth
+        return kept
+
+    # ---------------------------------------------------------------- control
+    def _raise_pending(self):
+        err, self._write_err = self._write_err, None
+        if err is not None:
+            raise CheckpointError("background checkpoint write failed") from err
+
+    def flush(self):
+        """Block until every queued snapshot is committed; surface any
+        background write error here."""
+        if self._q is not None:
+            self._q.join()
+        self._raise_pending()
+
+    def close(self, wait: bool = True):
+        """Drain (when ``wait``) and stop the writer thread. With
+        ``wait=False`` nothing here may block: if the writer is wedged on
+        hung I/O with a full queue, the sentinel is simply dropped and the
+        daemon thread dies with the process."""
+        if self._worker is not None and self._worker.is_alive():
+            if wait:
+                self._q.join()
+            try:
+                self._q.put_nowait(CheckpointManager._SENTINEL)
+            except queue.Full:
+                pass  # wedged writer; see docstring
+            self._worker.join(timeout=30 if wait else 1)
+        self._worker = None
+        self._raise_pending()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        # a crash mid-run must not hang on a drain of stale snapshots
+        self.close(wait=exc_type is None)
+        return False
+
+    def checkpoints(self) -> List[dict]:
+        """Committed entries, oldest first (copies)."""
+        with self._lock:
+            return [dict(e) for e in self._entries]
+
+    # ---------------------------------------------------------------- restore
+    def _restorable_entries(self) -> List[dict]:
+        with self._lock:
+            if self._entries:
+                return [dict(e) for e in self._entries]
+        return self._mf.scan_checkpoint_files(self.directory)
+
+    def _try_restore(self, entry: dict, load_updater: bool,
+                     arm_resume: bool):
+        path = os.path.join(self.directory, entry["file"])
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        if entry.get("sha256") is not None and \
+                self._mf.file_sha256(path) != entry["sha256"]:
+            raise CheckpointError(
+                f"checksum mismatch for {entry['file']} (torn/corrupt write)")
+        from deeplearning4j_tpu.utils.serialization import restore_checkpoint
+        model, meta = restore_checkpoint(path, load_updater=load_updater)
+        info = ResumeState(
+            step=int(meta.get("iteration", 0)),
+            epoch=int(meta.get("epoch", 0)),
+            batch_in_epoch=int(meta.get("batch_in_epoch", 0)),
+            path=path)
+        # informational provenance, never consumed by fit
+        model._restored_from = info
+        # the consumable marker is armed ONLY on the crash-resume path
+        # (restore_latest): a best-model restore is model SELECTION, and
+        # arming it there would make the user's next fine-tune fit()
+        # silently reinterpret num_epochs / skip unrelated batches
+        model._resume_state = info if arm_resume else None
+        return model
+
+    def restore_latest(self, load_updater: bool = True):
+        """Newest restorable checkpoint as a fresh model (``None`` when the
+        directory holds none). Walks the journal newest-first; a missing
+        file, sha mismatch or zip CRC failure logs and falls back to the
+        previous complete checkpoint. The returned model carries a
+        :class:`ResumeState` consumed by its next ``fit``."""
+        if self._worker is not None and self._worker.is_alive():
+            self.flush()
+        for entry in reversed(self._restorable_entries()):
+            try:
+                return self._try_restore(entry, load_updater, arm_resume=True)
+            except Exception as e:
+                log.warning("checkpoint %s unusable (%s: %s); falling back",
+                            entry.get("file"), type(e).__name__, e)
+        return None
+
+    def restore_best(self, direction: Optional[str] = None,
+                     load_updater: bool = True):
+        """Best-``metric`` restorable checkpoint (direction defaults to
+        ``keep_best`` or "min"); falls back to next-best on corruption.
+        Model selection, not crash resume: the returned model carries its
+        provenance in ``_restored_from`` but NO consumable resume marker —
+        a subsequent ``fit`` trains normally."""
+        if self._worker is not None and self._worker.is_alive():
+            self.flush()
+        entries = [e for e in self._restorable_entries()
+                   if e.get("metric") is not None]
+        direction = direction or self.keep_best or "min"
+        entries.sort(key=lambda e: e["metric"],
+                     reverse=(direction == "max"))
+        for entry in entries:
+            try:
+                return self._try_restore(entry, load_updater,
+                                         arm_resume=False)
+            except Exception as e:
+                log.warning("checkpoint %s unusable (%s: %s); falling back",
+                            entry.get("file"), type(e).__name__, e)
+        return None
+
+    # ------------------------------------------------------------- multi-host
+    def _barrier(self, what: str):
+        """Bounded collective barrier (watchdog deadline pattern): a dead
+        peer at a checkpoint point raises CollectiveTimeoutError with
+        process/device diagnostics instead of hanging the fleet."""
+        import jax
+        if jax.process_count() <= 1:
+            return
+        from deeplearning4j_tpu.parallel.watchdog import CollectiveWatchdog
+        from jax.experimental import multihost_utils
+        CollectiveWatchdog(timeout_s=self.barrier_timeout_s).call(
+            lambda: multihost_utils.sync_global_devices(f"checkpoint:{what}"),
+            what=f"checkpoint barrier ({what})")
+
+
+def consume_resume_state(model):
+    """Pop the model's resume marker (set by ``restore_latest``); returns a
+    :class:`ResumeState` or ``None``. Shared by every ``fit`` wire-in."""
+    rs = getattr(model, "_resume_state", None)
+    model._resume_state = None
+    return rs
+
+
+def resume_plan(model, num_epochs: int):
+    """Consume the model's resume marker and return ``(epochs_to_run,
+    skip_batches)`` for a fit targeting ``num_epochs`` TOTAL epochs. The
+    single definition of the resume arithmetic — every fit wire-in
+    (MultiLayerNetwork, ComputationGraph, ParallelWrapper, ClusterTrainer)
+    calls this instead of re-deriving it."""
+    rs = consume_resume_state(model)
+    if rs is None:
+        return num_epochs, 0
+    epochs_to_run = max(0, num_epochs - model.epoch)
+    if epochs_to_run == 0:
+        # legitimate when the checkpointed run had already reached the
+        # target, but silent no-op training would be baffling otherwise —
+        # say what happened and how to get plain semantics
+        log.warning(
+            "fit() on a restored model trains 0 epochs: num_epochs=%d is "
+            "the run's TOTAL target and the checkpoint is already at epoch "
+            "%d. To fine-tune a restored model with plain num_epochs "
+            "semantics, clear the marker first (model._resume_state = "
+            "None) or restore via restore_best().", num_epochs, model.epoch)
+    return epochs_to_run, int(rs.batch_in_epoch)
+
+
+_EXHAUSTED = object()
+
+
+def skip_consumed_batches(data, skip: int):
+    """One epoch pass over ``data`` minus its first ``skip`` batches,
+    WITHOUT materializing the skipped ones — callers place this UNDER any
+    prefetch/placement wrapper so already-consumed batches are never
+    staged, padded or transferred just to be discarded. (Bucket-padding
+    wrappers stay ABOVE the skip: pad targets must evolve exactly as in
+    the uninterrupted run.)
+
+    Raises when the stream ends before ``skip`` batches: the resume
+    contract requires replaying the interrupted run's data in the same
+    order, and an exhausted one-shot generator or shorter dataset would
+    otherwise silently train a no-op epoch and diverge from the
+    bitwise-resume guarantee."""
+    it = iter(data)
+    for i in range(skip):
+        if next(it, _EXHAUSTED) is _EXHAUSTED:
+            raise ValueError(
+                f"exact-step resume expected to skip {skip} already-"
+                f"consumed batches, but the data stream ended after {i} — "
+                "resume requires a re-iterable source replaying the "
+                "interrupted run's batches in the same order")
+    return it

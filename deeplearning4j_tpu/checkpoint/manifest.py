@@ -1,0 +1,152 @@
+"""Checksummed checkpoint journal + atomic commit primitives.
+
+Crash consistency contract (checkpoint/manager.py is the only writer):
+
+1. checkpoint bytes are written to ``<dir>/tmp/``, fsync'd, then
+   ``os.replace``'d to their final name (atomic on POSIX) and the directory
+   is fsync'd — a crash mid-write leaves only a ``tmp/`` orphan, never a
+   half-written ``ckpt-*.zip``;
+2. only AFTER the file is durable is its entry (with the file's sha256)
+   journaled into ``manifest.json``, itself rewritten atomically with an
+   embedded checksum over the entries payload.
+
+So at every instant the manifest describes only fully-committed files, and a
+torn manifest or a bit-rotted checkpoint is DETECTED (self-checksum /
+per-entry sha256) instead of restored: ``restore_latest`` falls back entry
+by entry, and a missing or corrupt manifest degrades to scanning the
+directory, where the zip layer's CRC checks still reject torn files.
+
+Reference analogue: none — DL4J's CheckpointListener writes in place with
+no journal; a crash mid-save loses the run. This is part of the durability
+substrate a preemptible-TPU deployment must supply itself.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import List, Optional
+
+MANIFEST_NAME = "manifest.json"
+TMP_DIR = "tmp"
+MANIFEST_VERSION = 1
+
+
+class ManifestError(RuntimeError):
+    """The manifest exists but is torn/corrupt (invalid JSON, bad
+    self-checksum, or wrong shape)."""
+
+
+def file_sha256(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def _entries_checksum(entries: List[dict]) -> str:
+    payload = json.dumps(entries, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def _fsync_dir(directory: str):
+    # directory fsync makes the rename itself durable; some filesystems
+    # (or platforms) don't support opening a directory — best effort there
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(directory: str, name: str, data: bytes,
+                       fsync_directory: bool = True) -> str:
+    """Write ``data`` as ``<directory>/<name>`` via tmp/ + fsync + rename.
+    Returns the final path. Callers see either the complete new file or no
+    file — never a prefix.
+
+    ``fsync_directory=False`` skips making the RENAME itself durable —
+    valid only when the caller immediately follows with another
+    atomic write in the SAME directory whose dir-fsync covers this one
+    (the manager's payload-then-manifest commit: the entry only becomes
+    durable together with, never before, the payload's directory entry)."""
+    tmp_dir = os.path.join(directory, TMP_DIR)
+    os.makedirs(tmp_dir, exist_ok=True)
+    fd, tmp_path = tempfile.mkstemp(dir=tmp_dir, prefix=name + ".", suffix=".part")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        final = os.path.join(directory, name)
+        os.replace(tmp_path, final)
+    except BaseException:
+        try:
+            os.remove(tmp_path)
+        except OSError:
+            pass
+        raise
+    if fsync_directory:
+        _fsync_dir(directory)
+    return final
+
+
+def clean_tmp(directory: str):
+    """Remove orphaned partial writes left by a crash mid-checkpoint."""
+    tmp_dir = os.path.join(directory, TMP_DIR)
+    if not os.path.isdir(tmp_dir):
+        return
+    for name in os.listdir(tmp_dir):
+        try:
+            os.remove(os.path.join(tmp_dir, name))
+        except OSError:
+            pass
+
+
+def write_manifest(directory: str, entries: List[dict]):
+    """Atomically rewrite the journal with a self-checksum over its entries."""
+    body = {"version": MANIFEST_VERSION, "entries": entries,
+            "checksum": _entries_checksum(entries)}
+    atomic_write_bytes(directory, MANIFEST_NAME,
+                       json.dumps(body, indent=1).encode())
+
+
+def load_manifest(directory: str) -> Optional[List[dict]]:
+    """Entries from the journal; ``None`` when no manifest exists yet.
+    Raises :class:`ManifestError` on a torn/corrupt manifest — callers fall
+    back to :func:`scan_checkpoint_files`."""
+    path = os.path.join(directory, MANIFEST_NAME)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, "r") as f:
+            body = json.load(f)
+        entries = body["entries"]
+        if not isinstance(entries, list):
+            raise TypeError("entries is not a list")
+        if body.get("checksum") != _entries_checksum(entries):
+            raise ValueError("manifest self-checksum mismatch")
+    except (ValueError, KeyError, TypeError, OSError) as e:
+        raise ManifestError(f"corrupt manifest at {path}: {e}") from e
+    return entries
+
+
+def scan_checkpoint_files(directory: str) -> List[dict]:
+    """Degraded-mode recovery: entries (without sha256) for every
+    ``ckpt-*.zip`` present, in filename (= commit) order. Used when the
+    manifest itself was lost or torn; the zip CRC layer still guards each
+    file's integrity during restore."""
+    if not os.path.isdir(directory):
+        return []
+    names = sorted(n for n in os.listdir(directory)
+                   if n.startswith("ckpt-") and n.endswith(".zip"))
+    return [{"file": n, "sha256": None} for n in names]

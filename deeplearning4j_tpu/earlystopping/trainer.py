@@ -46,10 +46,21 @@ class EarlyStoppingTrainer:
 
     ``score_calculator``: callable(model) -> float; defaults to loss on the
     validation iterator (reference scorecalc/DataSetLossCalculator.java).
+
+    ``checkpoint_manager`` (checkpoint.CheckpointManager) plugs the
+    checkpoint/ subsystem in as the saver backend: best/latest models
+    become durable, checksummed, retention-bounded checkpoints (the
+    manager implements the saver protocol — save_best_model /
+    save_latest_model / get_best_model via restore_best). Passing one
+    overrides ``config.model_saver``.
     """
 
     def __init__(self, config: EarlyStoppingConfiguration, model,
-                 train_data, validation_data=None, score_calculator=None):
+                 train_data, validation_data=None, score_calculator=None,
+                 checkpoint_manager=None):
+        if checkpoint_manager is not None:
+            config = dataclasses.replace(config,
+                                         model_saver=checkpoint_manager)
         self.config = config
         self.model = model
         self.train_data = train_data

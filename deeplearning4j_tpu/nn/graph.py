@@ -72,6 +72,12 @@ class ComputationGraph:
         self._rng = None
         self._rnn_carries = None
         self._last_features = None  # last fit minibatch (listener sampling)
+        # set by checkpoint.CheckpointManager.restore_latest; consumed by
+        # the next fit() for exact-step resume (skip already-seen batches).
+        # _restored_from is informational provenance (also set by
+        # restore_best) and never consumed.
+        self._resume_state = None
+        self._restored_from = None
         self._jit_cache = {}
         # per-network compile/dispatch counters (perf/compile_watch.py)
         self.compile_watch = CompileWatch("ComputationGraph")
@@ -414,19 +420,59 @@ class ComputationGraph:
         return fn
 
     # ------------------------------------------------------------------- fit
-    def fit(self, data, num_epochs: int = 1):
+    def fit(self, data, num_epochs: int = 1, bucket_policy=None,
+            prefetch: bool = False, checkpoint_manager=None):
         """Train on MultiDataSets (reference ComputationGraph.fit :978); plain
-        DataSets are adapted for single-input/single-output graphs."""
+        DataSets are adapted for single-input/single-output graphs.
+
+        ``bucket_policy`` (a perf.BucketPolicy, or True for the default)
+        pads every batch — DataSet or MultiDataSet — to a canonical bucket
+        shape with the padded rows masked out of every output's loss
+        (perf/bucketing.py pad_dataset / pad_multi_dataset), so an epoch
+        with a ragged final batch is ONE compiled program: MLN parity.
+        ``prefetch=True`` stages batch N+1 onto the device while step N
+        runs (perf/prefetch.py). ``checkpoint_manager`` checkpoints per its
+        triggers and makes the run resumable at the exact step — same
+        semantics as MultiLayerNetwork.fit (num_epochs is the TOTAL target
+        when resuming a restored model)."""
         if self.params is None:
             self.init()
         if isinstance(data, (DataSet, MultiDataSet)):
             data = [data]
+        if bucket_policy is not None:
+            from deeplearning4j_tpu.perf.bucketing import (
+                BucketPadDataSetIterator, BucketPolicy)
+            policy = (BucketPolicy() if bucket_policy is True
+                      else bucket_policy)
+            # above the resume skip: pad targets must evolve exactly as in
+            # the uninterrupted run (see multilayer.py fit)
+            data = BucketPadDataSetIterator(data, policy)
+        prefetch_cls = None
+        if prefetch:
+            from deeplearning4j_tpu.perf.prefetch import DevicePrefetchIterator
+            prefetch_cls = DevicePrefetchIterator
+        from deeplearning4j_tpu.checkpoint.manager import (
+            resume_plan, skip_consumed_batches)
+        epochs_to_run, skip = resume_plan(self, num_epochs)
         step = self._get_jitted("train")
-        for _ in range(num_epochs):
-            for ds in data:
+        for _ in range(epochs_to_run):
+            # skip UNDER the prefetch wrapper: already-consumed batches are
+            # never transferred just to be discarded (no rng split, no
+            # update — the restored chain stays exact)
+            stream = skip_consumed_batches(data, skip)
+            if prefetch_cls is not None:
+                stream = prefetch_cls(stream)
+            bi = skip
+            for ds in stream:
+                bi += 1
                 mds = MultiDataSet.from_dataset(ds) if isinstance(ds, DataSet) else ds
                 self._fit_batch(step, mds)
+                if checkpoint_manager is not None:
+                    checkpoint_manager.step_end(self, batch_in_epoch=bi)
+            skip = 0
             self.epoch += 1
+            if checkpoint_manager is not None:
+                checkpoint_manager.epoch_end(self)
         return self
 
     def _fit_batch(self, step, mds: MultiDataSet):

@@ -87,6 +87,12 @@ class MultiLayerNetwork:
         self.compile_watch = CompileWatch("MultiLayerNetwork")
         self._rnn_carries = None  # stateful rnnTimeStep carries
         self._last_features = None  # last fit minibatch (listener sampling)
+        # set by checkpoint.CheckpointManager.restore_latest; consumed by
+        # the next fit() for exact-step resume (skip already-seen batches).
+        # _restored_from is informational provenance (also set by
+        # restore_best) and never consumed.
+        self._resume_state = None
+        self._restored_from = None
 
     # ------------------------------------------------------------------ init
     def init(self, seed: Optional[int] = None) -> "MultiLayerNetwork":
@@ -304,6 +310,9 @@ class MultiLayerNetwork:
         the whole group still runs as ONE compiled scan program."""
         if self.params is None:
             self.init()
+        # a restored model's resume marker is only meaningful to fit()'s
+        # batch loop; consume it so it can't mis-skip a LATER fit call
+        self._resume_state = None
         if self.conf.optimization_algo not in ("sgd",
                                                "stochastic_gradient_descent"):
             raise ValueError("fit_fused supports the jitted SGD-family path "
@@ -562,7 +571,8 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, num_epochs: int = 1,
-            bucket_policy=None, prefetch: bool = False):
+            bucket_policy=None, prefetch: bool = False,
+            checkpoint_manager=None):
         """Train (reference MultiLayerNetwork.fit(DataSetIterator) :1156 and
         fit(INDArray, INDArray)). ``data`` may be a DataSetIterator-like
         iterable of DataSets, a DataSet, or a features array with ``labels``.
@@ -573,13 +583,26 @@ class MultiLayerNetwork:
         runs ONE compiled program instead of recompiling the train step for
         the tail (perf/bucketing.py; exact math for row-independent models,
         see pad_dataset). ``prefetch=True`` stages each batch onto the
-        device while the previous step runs (perf/prefetch.py)."""
+        device while the previous step runs (perf/prefetch.py).
+
+        ``checkpoint_manager`` (checkpoint.CheckpointManager) snapshots
+        params + updater state + rng + counters per its triggers after
+        each optimizer step, asynchronously and crash-consistently. A model
+        returned by ``restore_latest()`` carries a resume marker: its next
+        ``fit`` treats ``num_epochs`` as the run's TOTAL epoch target,
+        skipping the batches the checkpoint already consumed in its epoch
+        and continuing the restored rng chain — resume is bitwise-identical
+        to the uninterrupted run (``data`` must replay deterministically,
+        e.g. a list or a re-iterable iterator in a fixed order)."""
         if self.params is None:
             self.init()
         if labels is not None:
             data = [DataSet(np.asarray(data), np.asarray(labels))]
         elif isinstance(data, DataSet):
             data = [data]
+        from deeplearning4j_tpu.checkpoint.manager import (
+            resume_plan, skip_consumed_batches)
+        epochs_to_run, skip = resume_plan(self, num_epochs)
         if self.conf.optimization_algo not in (
                 "sgd", "stochastic_gradient_descent"):
             # full-batch solver path (reference Solver.java dispatch on
@@ -592,18 +615,25 @@ class MultiLayerNetwork:
                     "SGD step loop only", self.conf.optimization_algo)
             from deeplearning4j_tpu.optimize.solvers import Solver
             solver = Solver(self.conf.optimization_algo)
-            for _ in range(num_epochs):
+            for _ in range(epochs_to_run):
                 for listener in self.listeners:
                     listener.on_epoch_start(self)
-                for ds in data:
+                bi = skip
+                for ds in skip_consumed_batches(data, skip):
+                    bi += 1
                     solver.optimize(self, ds)
                     self.last_batch_size = ds.num_examples()
                     for listener in self.listeners:
                         listener.iteration_done(self, self.iteration, self.epoch)
                     self.iteration += 1
+                    if checkpoint_manager is not None:
+                        checkpoint_manager.step_end(self, batch_in_epoch=bi)
+                skip = 0
                 for listener in self.listeners:
                     listener.on_epoch_end(self)
                 self.epoch += 1
+                if checkpoint_manager is not None:
+                    checkpoint_manager.epoch_end(self)
             return self
         train_step = self._get_jitted("train")
         if bucket_policy is not None:
@@ -611,18 +641,36 @@ class MultiLayerNetwork:
                 BucketPadDataSetIterator, BucketPolicy)
             policy = (BucketPolicy() if bucket_policy is True
                       else bucket_policy)
+            # bucketing sits ABOVE the resume skip: pad targets must evolve
+            # exactly as in the uninterrupted run (they feed the jit shapes
+            # and, for batch-coupled layers like BN, the math)
             data = BucketPadDataSetIterator(data, policy)
+        prefetch_cls = None
         if prefetch:
             from deeplearning4j_tpu.perf.prefetch import DevicePrefetchIterator
-            data = DevicePrefetchIterator(data)
-        for _ in range(num_epochs):
+            prefetch_cls = DevicePrefetchIterator
+        for _ in range(epochs_to_run):
             for listener in self.listeners:
                 listener.on_epoch_start(self)
-            for ds in data:
+            # skip UNDER the prefetch wrapper: batches consumed before the
+            # checkpoint are never transferred just to be discarded (and no
+            # rng split / update runs for them — the restored chain stays
+            # exact)
+            stream = skip_consumed_batches(data, skip)
+            if prefetch_cls is not None:
+                stream = prefetch_cls(stream)
+            bi = skip
+            for ds in stream:
+                bi += 1
                 self._fit_batch(train_step, ds)
+                if checkpoint_manager is not None:
+                    checkpoint_manager.step_end(self, batch_in_epoch=bi)
+            skip = 0
             for listener in self.listeners:
                 listener.on_epoch_end(self)
             self.epoch += 1
+            if checkpoint_manager is not None:
+                checkpoint_manager.epoch_end(self)
         return self
 
     def _fit_batch(self, train_step, ds: DataSet):
@@ -688,6 +736,7 @@ class MultiLayerNetwork:
         ``iteration`` advances by the window count."""
         if self.params is None:
             self.init()
+        self._resume_state = None  # see fit_fused note
         if self.conf.backprop_type != "tbptt":
             raise ValueError("fit_tbptt_fused requires backprop_type='tbptt' "
                              "(this network is 'standard'; use fit/fit_fused)")

@@ -224,6 +224,14 @@ class CheckpointListener(TrainingListener):
 
     ``every_n_iterations`` or ``every_n_epochs`` must be set; ``keep_last``
     bounds disk use.
+
+    Superseded for production use by ``checkpoint.CheckpointManager``
+    (``fit(..., checkpoint_manager=)``): that subsystem writes
+    asynchronously off the step loop, commits atomically behind a
+    checksummed journal (torn writes fall back instead of restoring
+    garbage), saves the rng/step state for EXACT-step resume, and is
+    multi-host aware. This listener stays for reference-parity and simple
+    single-host save-every-N use.
     """
 
     def __init__(self, checkpoint_dir: str, every_n_iterations: int = 0,

@@ -109,6 +109,9 @@ class ParallelInference:
         self.requests_served = 0
         self.batches_dispatched = 0
         self.batch_sizes: "deque" = deque(maxlen=max(1, batch_size_history))
+        # pre-pad ROW counts per dispatch (batch_sizes counts coalesced
+        # REQUESTS): the histogram a learned bucket ladder trains on
+        self.row_sizes: "deque" = deque(maxlen=max(1, batch_size_history))
         self.bucket_dispatches: Counter = Counter()
         self.unwarmed_dispatches = 0
         self._warmed: set = set()
@@ -128,9 +131,10 @@ class ParallelInference:
              if self.bucket_policy is not None and n >= 1 else n)
         return t + (-t) % dp
 
-    def _record_dispatch_shape(self, target: int):
+    def _record_dispatch_shape(self, target: int, n_rows: int):
         with self._stats_lock:
             self.bucket_dispatches[target] += 1
+            self.row_sizes.append(n_rows)
             if target not in self._warmed:
                 self.unwarmed_dispatches += 1
 
@@ -146,7 +150,7 @@ class ParallelInference:
         with self.mesh:
             arr = pad_to_bucket(jnp.asarray(arr), target)
             if record:
-                self._record_dispatch_shape(target)
+                self._record_dispatch_shape(target, n)
             arr = jax.device_put(arr, data_sharding(self.mesh, arr.ndim))
             out = self.model.output(arr)
             return out[:n] if target != n else out
@@ -189,14 +193,28 @@ class ParallelInference:
             # unrecorded so warmup doesn't pollute the serving counters
             self._dispatch(np.zeros((target,) + feat_shape, ex.dtype),
                            target, record=False)
-            self._warmed.add(target)
-        return sorted(self._warmed)
+            with self._stats_lock:  # stats()/recording iterate this set
+                self._warmed.add(target)
+        with self._stats_lock:
+            return sorted(self._warmed)
 
-    def stats(self) -> dict:
-        """Serving observability: request/dispatch counts, batch-size
-        percentiles over the retained window, per-bucket dispatch counts,
-        warmed buckets, and the model's compile/dispatch counters."""
-        sizes = list(self.batch_sizes)
+    def learned_bucket_policy(self, max_compiles: int = 8) -> BucketPolicy:
+        """Latency-aware ladder learned from the recorded pre-pad row-count
+        histogram (``BucketPolicy.from_histogram``): at most ``max_compiles``
+        buckets placed where this server's traffic actually mass — swap it
+        in (new ParallelInference, or warmup a canary) when the static pow2
+        ladder over- or under-buckets the observed mix."""
+        with self._stats_lock:
+            rows = list(self.row_sizes)
+        rows = [r for r in rows if r >= 1]
+        if not rows:
+            raise ValueError(
+                "no dispatches recorded yet — serve some traffic (or seed "
+                "row_sizes) before learning a bucket ladder")
+        return BucketPolicy.from_histogram(rows, max_compiles=max_compiles)
+
+    @staticmethod
+    def _size_summary(sizes) -> dict:
         summary = {"count": len(sizes)}
         if sizes:
             summary.update(
@@ -204,15 +222,34 @@ class ParallelInference:
                 p50=float(np.percentile(sizes, 50)),
                 p95=float(np.percentile(sizes, 95)),
                 max=int(max(sizes)))
+        return summary
+
+    def stats(self) -> dict:
+        """Serving observability: request/dispatch counts, batch-size and
+        row-count percentiles over the retained window, per-bucket dispatch
+        counts, warmed buckets, and the model's compile/dispatch
+        counters."""
+        with self._stats_lock:
+            # every mutable counter is read under the SAME lock the worker
+            # mutates under — dict(bucket_dispatches) racing a new-key
+            # insert would raise "dictionary changed size during iteration"
+            sizes = list(self.batch_sizes)
+            rows = list(self.row_sizes)
+            requests_served = self.requests_served
+            batches_dispatched = self.batches_dispatched
+            warmed = sorted(self._warmed)
+            bucket_dispatches = dict(self.bucket_dispatches)
+            unwarmed = self.unwarmed_dispatches
         out = {
-            "requests_served": self.requests_served,
-            "batches_dispatched": self.batches_dispatched,
-            "batch_size": summary,
+            "requests_served": requests_served,
+            "batches_dispatched": batches_dispatched,
+            "batch_size": self._size_summary(sizes),
+            "row_size": self._size_summary(rows),
             "bucket_policy": (None if self.bucket_policy is None
                               else repr(self.bucket_policy)),
-            "warmed_buckets": sorted(self._warmed),
-            "bucket_dispatches": dict(self.bucket_dispatches),
-            "unwarmed_dispatches": self.unwarmed_dispatches,
+            "warmed_buckets": warmed,
+            "bucket_dispatches": bucket_dispatches,
+            "unwarmed_dispatches": unwarmed,
         }
         cw = getattr(self.model, "compile_watch", None)
         if cw is not None:
@@ -336,6 +373,7 @@ class ParallelInference:
             finally:
                 with self._inflight_lock:
                     self._inflight = []
-            self.requests_served += len(items)
-            self.batches_dispatched += 1
-            self.batch_sizes.append(len(items))
+            with self._stats_lock:  # stats() iterates these concurrently
+                self.requests_served += len(items)
+                self.batches_dispatched += 1
+                self.batch_sizes.append(len(items))

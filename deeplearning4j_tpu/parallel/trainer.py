@@ -192,35 +192,56 @@ class ParallelWrapper:
         return True
 
     # ---- training (reference ParallelWrapper.fit dispatch loop :210) ----
-    def fit(self, data, num_epochs: int = 1, prefetch: bool = False):
+    def fit(self, data, num_epochs: int = 1, prefetch: bool = False,
+            checkpoint_manager=None):
         """``prefetch=True`` wraps the iterator in a DevicePrefetchIterator
         (perf/prefetch.py): batch N+1's sharded device_put is issued while
         step N runs, so host→device transfer stops serializing the step
         loop. Ragged batches pass through on host and keep the usual
-        drop-ragged policy."""
+        drop-ragged policy.
+
+        ``checkpoint_manager`` (checkpoint.CheckpointManager) checkpoints
+        after trained batches per its triggers and resumes a restored model
+        at the exact step — same semantics as MultiLayerNetwork.fit
+        (num_epochs is the run's TOTAL target when resuming)."""
         self._place_params()
         explicit_single = isinstance(data, DataSet)
         if explicit_single:
             data = [data]
-        elif prefetch:
+        prefetch_cls = None
+        if prefetch and not explicit_single:
             from deeplearning4j_tpu.perf.prefetch import DevicePrefetchIterator
-            data = DevicePrefetchIterator(data, mesh=self.mesh)
-        for _ in range(num_epochs):
+            prefetch_cls = DevicePrefetchIterator
+        from deeplearning4j_tpu.checkpoint.manager import (
+            resume_plan, skip_consumed_batches)
+        epochs_to_run, skip = resume_plan(self.model, num_epochs)
+        for _ in range(epochs_to_run):
             for listener in self.model.listeners:
                 listener.on_epoch_start(self.model)
-            trained = seen = 0
-            for ds in data:
+            trained = 0
+            seen = skip
+            resumed_mid_epoch = skip > 0
+            # skip UNDER the prefetch wrapper: consumed batches are never
+            # sharded/transferred just to be discarded
+            stream = skip_consumed_batches(data, skip)
+            if prefetch_cls is not None:
+                stream = prefetch_cls(stream, mesh=self.mesh)
+            for ds in stream:
                 seen += 1
                 # a single explicit ragged DataSet raises (dropping it would
                 # train on nothing); iterator tail batches drop-remainder
                 if self.fit_batch(ds, drop_ragged=not explicit_single):
                     trained += 1
+                    if checkpoint_manager is not None:
+                        checkpoint_manager.step_end(self.model,
+                                                    batch_in_epoch=seen)
+            skip = 0
             if seen == 0:
                 raise ValueError(
                     "No batches this epoch — the data iterable is empty or a "
                     "one-shot generator exhausted by a previous epoch; pass a "
                     "re-iterable DataSetIterator")
-            if trained == 0:
+            if trained == 0 and not resumed_mid_epoch:
                 raise ValueError(
                     "Every batch this epoch was dropped as ragged — the "
                     f"batch size never divides the data-parallel size "
@@ -228,6 +249,8 @@ class ParallelWrapper:
             for listener in self.model.listeners:
                 listener.on_epoch_end(self.model)
             self.model.epoch += 1
+            if checkpoint_manager is not None:
+                checkpoint_manager.epoch_end(self.model)
             if self.stats is not None:
                 # steps dispatch asynchronously: one sync per epoch shows
                 # the true device time under "epoch_sync"
@@ -303,6 +326,11 @@ class ClusterTrainer(ParallelWrapper):
     # ParallelWrapper.fit_batch / EarlyStoppingParallelTrainer route here:
     # in cluster mode the incoming DataSet is the process-LOCAL shard
     def _shard_dataset(self, ds: DataSet) -> DataSet:
+        if getattr(ds, "_staged_global", False):
+            # assembled one batch ahead by the prefetch stage; the marker
+            # (not an array-type test) distinguishes this from a USER
+            # device-resident local DataSet, which must still assemble
+            return ds
         n_global = ds.num_examples() * jax.process_count()
         dp = self.mesh.shape[DATA_AXIS]
         if n_global % dp:
@@ -324,27 +352,38 @@ class ClusterTrainer(ParallelWrapper):
                           // max(1, jax.process_count()))
         return bool(ds.num_examples() % local_share)
 
-    def fit(self, data, num_epochs: int = 1, prefetch: bool = False):
+    def fit(self, data, num_epochs: int = 1, prefetch: bool = False,
+            checkpoint_manager=None):
         """Train from an ORDINARY global iterator: every process walks the
         same iterator and this trainer internally takes the process's row
         shard of each batch (parallel/sharding.py), so user code needs no
         manual pre-sharding (reference SparkDl4jMultiLayer.fit(RDD)
         ergonomics).
 
-        ``prefetch`` is accepted for signature parity with
-        ParallelWrapper.fit but is a no-op here: the multi-host path
-        assembles each global batch from process-LOCAL host rows
-        (``make_array_from_process_local_data``), which has no
-        pre-placeable single-device layout."""
+        ``prefetch=True`` stages batch N+1's global-batch assembly
+        (``make_array_from_process_local_data`` — an async transfer, like
+        device_put) while step N runs; see ``fit_local_shard``.
+        ``checkpoint_manager`` checkpoints per its triggers — in cluster
+        mode only process 0 writes, the others barrier under the watchdog
+        deadline (checkpoint/manager.py)."""
         from deeplearning4j_tpu.parallel.sharding import shard_iterator
-        if prefetch:
-            log.warning("ClusterTrainer.fit(prefetch=True) is a no-op: "
-                        "global batches are assembled from process-local "
-                        "rows at dispatch time")
         if isinstance(data, DataSet):
             data = [data]
         local = shard_iterator(data) if jax.process_count() > 1 else data
-        return self.fit_local_shard(local, num_epochs=num_epochs)
+        return self.fit_local_shard(local, num_epochs=num_epochs,
+                                    prefetch=prefetch,
+                                    checkpoint_manager=checkpoint_manager)
+
+    def _stage_local_batch(self, ds: DataSet) -> DataSet:
+        """Prefetch hook (perf/prefetch.py place_fn): assemble the global
+        sharded batch EARLY so its host→device transfer overlaps the
+        in-flight step. Ragged batches return unchanged — host-side — so
+        the dispatch-time divisibility error stays loud and clear."""
+        if self._is_ragged(ds):
+            return ds
+        staged = self._shard_dataset(ds)
+        staged._staged_global = True  # consumed by _shard_dataset/stats
+        return staged
 
     def score_local_shard(self, ds: DataSet) -> float:
         """Loss over a validation batch given as per-process local rows
@@ -357,14 +396,24 @@ class ClusterTrainer(ParallelWrapper):
 
     def fit_local_shard(self, data, num_epochs: int = 1,
                         collective_timeout_s: Optional[float] = None,
-                        watchdog_every: int = 10):
+                        watchdog_every: int = 10, prefetch: bool = False,
+                        checkpoint_manager=None):
         """Feed per-host local batches; assembles the global sharded array
         from process-local data (multi-host path of ICI+DCN training).
 
         ``collective_timeout_s`` arms a CollectiveWatchdog (SURVEY §5): every
         ``watchdog_every`` batches the host syncs the dispatched step under a
         deadline, so a hung DCN collective (dead peer / partition) raises a
-        diagnostic CollectiveTimeoutError instead of blocking forever."""
+        diagnostic CollectiveTimeoutError instead of blocking forever.
+
+        ``prefetch=True`` runs the global-batch assembly
+        (``_stage_local_batch``) one batch ahead through a
+        DevicePrefetchIterator, so batch N+1's host→device transfer
+        overlaps step N instead of serializing the loop.
+        ``checkpoint_manager`` checkpoints after each step per its triggers
+        (process 0 writes, peers barrier) and resumes a restored model at
+        the exact step, skipping the batches its checkpoint already
+        consumed."""
         wd = None
         if collective_timeout_s is not None:
             from deeplearning4j_tpu.parallel.watchdog import CollectiveWatchdog
@@ -372,13 +421,26 @@ class ClusterTrainer(ParallelWrapper):
         self._place_params()
         if isinstance(data, DataSet):
             data = [data]
+        prefetch_cls = None
+        if prefetch:
+            from deeplearning4j_tpu.perf.prefetch import DevicePrefetchIterator
+            prefetch_cls = DevicePrefetchIterator
+        from deeplearning4j_tpu.checkpoint.manager import (
+            resume_plan, skip_consumed_batches)
+        epochs_to_run, skip = resume_plan(self.model, num_epochs)
         step_no = 0
         with self.mesh:
-            for _ in range(num_epochs):
+            for _ in range(epochs_to_run):
                 for listener in self.model.listeners:
                     listener.on_epoch_start(self.model)
-                seen = 0
-                for ds in data:
+                seen = skip
+                # skip UNDER the prefetch wrapper: consumed batches are
+                # never assembled/transferred just to be discarded
+                stream = skip_consumed_batches(data, skip)
+                if prefetch_cls is not None:
+                    stream = prefetch_cls(stream,
+                                          place_fn=self._stage_local_batch)
+                for ds in stream:
                     # _model_fit_batch, not model.fit: per-epoch hooks and
                     # the epoch counter must fire once per EPOCH, not once
                     # per minibatch (same contract as ParallelWrapper.fit)
@@ -386,11 +448,18 @@ class ClusterTrainer(ParallelWrapper):
                         if self.stats is None:
                             self._model_fit_batch(self._shard_dataset(d))
                         else:
+                            # a prefetch-staged batch is already the GLOBAL
+                            # array: normalize the examples counter back to
+                            # process-local rows so the metric doesn't
+                            # change meaning with the prefetch flag
+                            n_local = d.num_examples()
+                            if getattr(d, "_staged_global", False):
+                                n_local //= max(1, jax.process_count())
                             with self.stats.time("data_placement"):
                                 sharded = self._shard_dataset(d)
                             with self.stats.time("train_dispatch"):
                                 self._model_fit_batch(sharded)
-                            self.stats.examples += d.num_examples()
+                            self.stats.examples += n_local
                             self.stats.minibatches += 1
                     if wd is None:
                         one_step()
@@ -405,6 +474,10 @@ class ClusterTrainer(ParallelWrapper):
                     if wd is not None and step_no % max(1, watchdog_every) == 0:
                         wd.sync(self.model.params,
                                 what=f"cluster step {step_no}")
+                    if checkpoint_manager is not None:
+                        checkpoint_manager.step_end(self.model,
+                                                    batch_in_epoch=seen)
+                skip = 0
                 if seen == 0:
                     raise ValueError(
                         "No batches this epoch — the data iterable is empty "
@@ -413,6 +486,8 @@ class ClusterTrainer(ParallelWrapper):
                 for listener in self.model.listeners:
                     listener.on_epoch_end(self.model)
                 self.model.epoch += 1
+                if checkpoint_manager is not None:
+                    checkpoint_manager.epoch_end(self.model)
                 self._record_compile_counters()
             if wd is not None:
                 # tail steps after the last every-N sync must not escape the
@@ -437,11 +512,14 @@ class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
 
     def __init__(self, config, model, train_data, validation_data=None,
                  score_calculator=None, mesh: Optional[Mesh] = None,
-                 tensor_parallel: bool = False, cluster: bool = False):
+                 tensor_parallel: bool = False, cluster: bool = False,
+                 checkpoint_manager=None):
         """``cluster=True`` routes batches through a ClusterTrainer (multi-
         host assembly of per-process local shards) and, when no explicit
         score_calculator is given, scores validation data through the same
-        multi-host path (local rows per process, global loss)."""
+        multi-host path (local rows per process, global loss).
+        ``checkpoint_manager`` plugs checkpoint/ in as the saver backend,
+        exactly as on the base EarlyStoppingTrainer."""
         trainer_holder = []
         if cluster and score_calculator is None and validation_data is not None:
             def score_calculator(m):
@@ -452,7 +530,8 @@ class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
                     n += ds.num_examples()
                 return total / max(n, 1)
         super().__init__(config, model, train_data, validation_data,
-                         score_calculator)
+                         score_calculator,
+                         checkpoint_manager=checkpoint_manager)
         if cluster:
             self.wrapper = ClusterTrainer(model, mesh=mesh,
                                           tensor_parallel=tensor_parallel)

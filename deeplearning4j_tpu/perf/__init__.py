@@ -15,6 +15,7 @@ from deeplearning4j_tpu.perf.bucketing import (  # noqa: F401
     BucketPadDataSetIterator,
     BucketPolicy,
     pad_dataset,
+    pad_multi_dataset,
     pad_to_bucket,
     unpad,
 )

@@ -18,11 +18,12 @@ the execution substrate the TPU port must supply itself (PAPER.md).
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Sequence
+from collections import Counter
+from typing import Iterable, List, Optional, Sequence
 
 import numpy as np
 
-from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 
 
 class BucketPolicy:
@@ -81,6 +82,60 @@ class BucketPolicy:
         if self._explicit is not None:
             return f"BucketPolicy(buckets={self._explicit})"
         return f"BucketPolicy(floor={self.floor}, cap={self.cap})"
+
+    @classmethod
+    def from_histogram(cls, batch_sizes: Iterable[int],
+                       max_compiles: int = 8) -> "BucketPolicy":
+        """Learn a latency-aware explicit ladder from OBSERVED batch sizes
+        (e.g. the pre-pad row counts ``ParallelInference.stats()`` records
+        — see ``ParallelInference.learned_bucket_policy``).
+
+        Dispatch latency scales with padded rows, so the expected cost of a
+        ladder over a traffic mix is ``sum_s count(s) * bucket(s)``. This
+        solves that exactly: contiguous-partition DP over the distinct
+        observed sizes (O(n²·K)), at most ``max_compiles`` buckets — each
+        bucket is one compiled program, so K IS the compile budget. A
+        pow2 ladder pads a size-9 batch to 16 (78% overhead) even when 9
+        is the p95 of traffic; the learned ladder puts a bucket AT the
+        mass. Sizes above the learned top round up to a multiple of it
+        (BucketPolicy's explicit-ladder overflow rule), so unseen giants
+        still dispatch."""
+        hist = Counter(int(s) for s in batch_sizes)
+        if any(s < 1 for s in hist):
+            raise ValueError("batch sizes must be >= 1")
+        if not hist:
+            raise ValueError("empty batch-size histogram")
+        if max_compiles < 1:
+            raise ValueError(f"max_compiles must be >= 1, got {max_compiles}")
+        vals = sorted(hist)
+        cnts = [hist[v] for v in vals]
+        n = len(vals)
+        K = min(int(max_compiles), n)
+        pref = [0] * (n + 1)
+        for i, c in enumerate(cnts):
+            pref[i + 1] = pref[i] + c
+        # best[k][j]: min cost covering sizes[0..j] with k buckets, the
+        # k-th bucket sitting at vals[j] (every group's bucket must be its
+        # largest member — anything bigger only adds padding)
+        inf = float("inf")
+        best = [[inf] * n for _ in range(K + 1)]
+        back = [[-1] * n for _ in range(K + 1)]
+        for j in range(n):
+            best[1][j] = vals[j] * pref[j + 1]
+        for k in range(2, K + 1):
+            for j in range(k - 1, n):
+                for i in range(k - 2, j):
+                    c = best[k - 1][i] + vals[j] * (pref[j + 1] - pref[i + 1])
+                    if c < best[k][j]:
+                        best[k][j] = c
+                        back[k][j] = i
+        k = min(range(1, K + 1), key=lambda kk: (best[kk][n - 1], kk))
+        buckets, j = [], n - 1
+        while k >= 1:
+            buckets.append(vals[j])
+            j = back[k][j]
+            k -= 1
+        return cls(buckets=sorted(buckets))
 
 
 def pad_to_bucket(arr, target: int, axis: int = 0):
@@ -154,18 +209,12 @@ def pad_dataset(ds: DataSet, target: int, ensure_lmask: bool = False) -> DataSet
     labels = pad_to_bucket(ds.labels, target)
     labels_nd = np.asarray(ds.labels).ndim
     if ds.labels_mask is not None:
-        lmask = np.concatenate([
-            np.asarray(ds.labels_mask, np.float32),
-            np.zeros((target - n,) + np.asarray(ds.labels_mask).shape[1:],
-                     np.float32)])
+        lmask = _pad_mask_rows(ds.labels_mask, target, n, 0.0)
     elif ds.features_mask is not None and labels_nd >= 3:
         # sequence OUTPUTS: the loss would have used the propagated features
         # mask; carry it over with zero rows for the padding (exact whenever
         # the mask reaches the output layer unchanged — the common rnn case)
-        lmask = np.concatenate([
-            np.asarray(ds.features_mask, np.float32),
-            np.zeros((target - n,) + np.asarray(ds.features_mask).shape[1:],
-                     np.float32)])
+        lmask = _pad_mask_rows(ds.features_mask, target, n, 0.0)
     else:
         # 2-D labels (incl. masked-sequence-INPUT classifiers, where the
         # time mask dies with the collapsed time axis and the loss runs
@@ -174,21 +223,67 @@ def pad_dataset(ds: DataSet, target: int, ensure_lmask: bool = False) -> DataSet
                      if labels_nd >= 3 else ())
         lmask = _ones_like_mask(row_shape, n, target)
     if ds.features_mask is not None:
-        fmask = np.concatenate([
-            np.asarray(ds.features_mask, np.float32),
-            np.ones((target - n,) + np.asarray(ds.features_mask).shape[1:],
-                    np.float32)])
+        # ones, not zeros: see pad_multi_dataset note on 0/0 time-pooling
+        fmask = _pad_mask_rows(ds.features_mask, target, n, 1.0)
     else:
         fmask = None
     return DataSet(feats, labels, fmask, lmask)
 
 
+def _pad_mask_rows(mask, target: int, n: int, fill: float) -> np.ndarray:
+    m = np.asarray(mask, np.float32)
+    pad = np.full((target - n,) + m.shape[1:], fill, np.float32)
+    return np.concatenate([m, pad])
+
+
+def pad_multi_dataset(mds: MultiDataSet, target: int,
+                      ensure_lmask: bool = False) -> MultiDataSet:
+    """``pad_dataset`` for the ComputationGraph currency: every input and
+    label pads to ``target`` examples; every output gains a labels mask
+    zeroing the padded rows out of ITS loss term (graph losses sum over
+    outputs, each masked independently). Per-output mask fabrication
+    follows pad_dataset's rules, with one DAG-specific caveat: an absent
+    sequence-output mask borrows the features mask only when the graph has
+    exactly ONE — with several inputs, which mask reaches which output is
+    graph topology, not something padding can guess, so those outputs get
+    the conservative ones-over-real-rows mask instead."""
+    n = mds.num_examples()
+    k_out = len(mds.labels)
+    lmasks = (list(mds.labels_masks) if mds.labels_masks is not None
+              else [None] * k_out)
+    if n == target and not (ensure_lmask and any(m is None for m in lmasks)):
+        return mds
+    feats = [pad_to_bucket(f, target) for f in mds.features]
+    labels = [pad_to_bucket(l, target) for l in mds.labels]
+    fmasks_in = (list(mds.features_masks) if mds.features_masks is not None
+                 else [None] * len(mds.features))
+    present_fm = [m for m in fmasks_in if m is not None]
+    new_lmasks = []
+    for y, lm in zip(mds.labels, lmasks):
+        labels_nd = np.asarray(y).ndim
+        if lm is not None:
+            new_lmasks.append(_pad_mask_rows(lm, target, n, 0.0))
+        elif len(present_fm) == 1 and labels_nd >= 3:
+            new_lmasks.append(_pad_mask_rows(present_fm[0], target, n, 0.0))
+        else:
+            row_shape = (np.asarray(y).shape[1:-1] if labels_nd >= 3 else ())
+            new_lmasks.append(np.asarray(_ones_like_mask(row_shape, n, target)))
+    new_fmasks = None
+    if mds.features_masks is not None:
+        # ones, not zeros: all-zero per-row feature masks make masked
+        # time-pooling divide 0/0 (same rule as pad_dataset)
+        new_fmasks = [None if m is None else _pad_mask_rows(m, target, n, 1.0)
+                      for m in fmasks_in]
+    return MultiDataSet(feats, labels, new_fmasks, new_lmasks)
+
+
 class BucketPadDataSetIterator:
-    """Wrap any iterable of DataSets so every emitted batch lands on a
-    bucket shape (``pad_dataset`` semantics). Within one pass, a batch
-    smaller than the largest size already seen pads up to that size — so a
-    ragged FINAL batch reuses the epoch's one compiled program instead of
-    compiling a second, smaller one. Re-iterable iff the base is.
+    """Wrap any iterable of DataSets — or MultiDataSets (ComputationGraph)
+    — so every emitted batch lands on a bucket shape (``pad_dataset`` /
+    ``pad_multi_dataset`` semantics). Within one pass, a batch smaller than
+    the largest size already seen pads up to that size — so a ragged FINAL
+    batch reuses the epoch's one compiled program instead of compiling a
+    second, smaller one. Re-iterable iff the base is.
     """
 
     def __init__(self, base, policy: Optional[BucketPolicy] = None):
@@ -202,7 +297,10 @@ class BucketPadDataSetIterator:
             max_seen = max(max_seen, target)
             # ensure_lmask: full batches carry an all-ones mask so the
             # padded tail shares their jit signature (one program per epoch)
-            yield pad_dataset(ds, target, ensure_lmask=True)
+            if isinstance(ds, MultiDataSet):
+                yield pad_multi_dataset(ds, target, ensure_lmask=True)
+            else:
+                yield pad_dataset(ds, target, ensure_lmask=True)
 
     def reset(self):
         if hasattr(self._base, "reset"):

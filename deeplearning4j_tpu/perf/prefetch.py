@@ -27,12 +27,13 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.datasets.iterators import DataSetIterator
 
 
 class DevicePrefetchIterator(DataSetIterator):
-    """Yield DataSets whose arrays are already resident on device.
+    """Yield DataSets (or MultiDataSets) whose arrays are already resident
+    on device.
 
     ``mesh`` shards the batch axis over the mesh's 'data' axis (the layout
     ParallelWrapper trains on — its own ``device_put`` then becomes a
@@ -40,15 +41,25 @@ class DevicePrefetchIterator(DataSetIterator):
     does not divide the mesh's data axis passes through as host arrays
     (the trainer's ragged-batch policy, drop or raise, stays in charge).
 
+    ``place_fn`` overrides the placement entirely: a ``ds -> ds`` callable
+    whose result is yielded in the batch's place. ClusterTrainer uses this
+    to issue its multi-host global-batch assembly
+    (``make_array_from_process_local_data``) one batch ahead — the device
+    transfer of batch N+1 then rides alongside step N exactly like the
+    single-host device_put path. Returning the batch UNCHANGED marks it
+    passed-through (host-side), keeping the caller's ragged policy in
+    charge.
+
     ``lookahead`` is the number of batches in flight beyond the one being
     consumed; 1 (double buffering) is right unless transfers are much
     shorter than steps AND the source is bursty.
     """
 
-    def __init__(self, base, mesh=None, lookahead: int = 1):
+    def __init__(self, base, mesh=None, lookahead: int = 1, place_fn=None):
         self._base = base
         self._mesh = mesh
         self._lookahead = max(1, int(lookahead))
+        self._place_fn = place_fn
         self.batches_prefetched = 0
         self.batches_passed_through = 0
 
@@ -62,13 +73,28 @@ class DevicePrefetchIterator(DataSetIterator):
             return jax.device_put(arr, data_sharding(self._mesh, arr.ndim))
         return jax.device_put(arr)
 
-    def _place(self, ds: DataSet) -> DataSet:
+    def _place(self, ds):
+        if self._place_fn is not None:
+            out = self._place_fn(ds)
+            if out is ds:  # unchanged == declined (e.g. ragged)
+                self.batches_passed_through += 1
+            else:
+                self.batches_prefetched += 1
+            return out
         if self._mesh is not None:
             from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
             if ds.num_examples() % self._mesh.shape[DATA_AXIS]:
                 self.batches_passed_through += 1
                 return ds  # ragged: leave on host, trainer decides
         self.batches_prefetched += 1
+        if isinstance(ds, MultiDataSet):
+            def place_list(arrs):
+                return (None if arrs is None
+                        else [self._place_array(a) for a in arrs])
+            return MultiDataSet(place_list(ds.features),
+                                place_list(ds.labels),
+                                place_list(ds.features_masks),
+                                place_list(ds.labels_masks))
         return DataSet(self._place_array(ds.features),
                        self._place_array(ds.labels),
                        self._place_array(ds.features_mask),

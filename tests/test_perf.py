@@ -69,6 +69,52 @@ def test_bucket_policy_explicit_ladder():
     assert p.bucket(17) == 32 and p.bucket(33) == 48  # multiples of 16
 
 
+def test_bucket_policy_from_histogram_learned_ladder():
+    """Satellite: the DP places buckets where traffic mass sits, minimizing
+    expected dispatched rows under the compile budget."""
+    # 100 single-row requests + 5 of size 32: one bucket would pad every
+    # singleton to 32 (cost 3360); two buckets [1, 32] cost 260
+    p = BucketPolicy.from_histogram([1] * 100 + [32] * 5, max_compiles=2)
+    assert repr(p) == "BucketPolicy(buckets=[1, 32])"
+    assert p.bucket(1) == 1 and p.bucket(2) == 32
+    # K=1 must still cover the max
+    p1 = BucketPolicy.from_histogram([1] * 100 + [32] * 5, max_compiles=1)
+    assert repr(p1) == "BucketPolicy(buckets=[32])"
+    # mass at 9: the pow2 ladder would pad 9 -> 16; the learned one won't
+    p9 = BucketPolicy.from_histogram([1, 9, 9, 9, 9, 9, 9, 16],
+                                     max_compiles=2)
+    assert p9.bucket(9) == 9
+    # above the learned top: multiples-of-top overflow rule still applies
+    assert p9.bucket(40) % max(9, 16) == 0
+    # compile budget >= distinct sizes: exact ladder, zero padding
+    px = BucketPolicy.from_histogram([3, 5, 7], max_compiles=8)
+    assert [px.bucket(n) for n in (3, 5, 7)] == [3, 5, 7]
+    with pytest.raises(ValueError):
+        BucketPolicy.from_histogram([], max_compiles=2)
+    with pytest.raises(ValueError):
+        BucketPolicy.from_histogram([0, 3], max_compiles=2)
+    with pytest.raises(ValueError):
+        BucketPolicy.from_histogram([3], max_compiles=0)
+
+
+def test_parallel_inference_row_stats_and_learned_policy(devices):
+    """Satellite: stats() records the pre-pad ROW histogram (batch_sizes
+    counts coalesced requests) and learned_bucket_policy() trains on it."""
+    net = _net(seed=23)
+    pi = ParallelInference(net, mesh=make_mesh())
+    rng = np.random.default_rng(4)
+    for n in (3, 3, 3, 9, 9, 20):
+        pi.output(rng.random((n, 4), np.float32))
+    st = pi.stats()
+    assert st["row_size"]["count"] == 6
+    assert st["row_size"]["max"] == 20 and st["row_size"]["p50"] == 6.0
+    learned = pi.learned_bucket_policy(max_compiles=3)
+    assert learned.bucket(3) == 3 and learned.bucket(9) == 9
+    assert learned.bucket(20) == 20
+    with pytest.raises(ValueError):
+        ParallelInference(net, mesh=make_mesh()).learned_bucket_policy()
+
+
 def test_bucket_policy_cap_is_never_overshot():
     # a non-power-of-two cap is typically a memory budget: the pow2 ladder
     # must clamp to it, not jump past it
@@ -331,14 +377,122 @@ def test_parallel_wrapper_prefetch_matches_and_reports_compiles(devices):
     assert "model_compiles" in pw.stats.to_string()
 
 
-def test_cluster_trainer_fit_accepts_prefetch_kwarg(devices):
-    """Signature parity: ClusterTrainer.fit must accept prefetch= (no-op
-    under multi-host batch assembly, but it must not TypeError)."""
+def test_cluster_trainer_fit_prefetch_matches_plain(devices):
+    """Satellite (ROADMAP open item): ClusterTrainer prefetch is REAL now —
+    the global-batch assembly of batch N+1 is staged through a
+    DevicePrefetchIterator while step N runs — and changes nothing
+    numerically."""
     from deeplearning4j_tpu.parallel import ClusterTrainer
-    net = _net(seed=17)
+    ds = _ragged_batches(n=144, batch=48)  # 48x3, all shardable over dp=8
+    a = _net(seed=17)
+    ClusterTrainer(a, mesh=make_mesh()).fit(ds, num_epochs=2)
+    b = _net(seed=17)
+    ClusterTrainer(b, mesh=make_mesh()).fit(ds, num_epochs=2, prefetch=True)
+    for la, lb in zip(jax.tree_util.tree_leaves(a.params),
+                      jax.tree_util.tree_leaves(b.params)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=1e-6, atol=1e-7)
+    assert b.score() is not None
+
+
+def test_cluster_trainer_fit_local_shard_prefetch_stages_batches(devices):
+    """fit_local_shard(prefetch=True) assembles ahead via the place_fn hook;
+    a staged (already-global) batch must not be re-assembled at dispatch."""
+    from deeplearning4j_tpu.parallel import ClusterTrainer
     ds = _ragged_batches(n=96, batch=48)
-    ClusterTrainer(net, mesh=make_mesh()).fit(ds, num_epochs=1, prefetch=True)
-    assert net.score() is not None
+    a = _net(seed=19)
+    ClusterTrainer(a, mesh=make_mesh()).fit_local_shard(ds, num_epochs=2)
+    b = _net(seed=19)
+    ClusterTrainer(b, mesh=make_mesh()).fit_local_shard(ds, num_epochs=2,
+                                                        prefetch=True)
+    for la, lb in zip(jax.tree_util.tree_leaves(a.params),
+                      jax.tree_util.tree_leaves(b.params)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=1e-6, atol=1e-7)
+
+
+# ----------------------------------------------- ComputationGraph parity
+def _graph(seed=5):
+    from deeplearning4j_tpu.nn.conf.graph import GraphBuilder, MergeVertex
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    conf = (GraphBuilder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=12, activation="relu"), "in")
+            .add_layer("d2", DenseLayer(n_out=12, activation="tanh"), "in")
+            .add_vertex("merge", MergeVertex(), "d1", "d2")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent",
+                                          updater=Adam(0.02)), "merge")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    return ComputationGraph(conf).init()
+
+
+def test_graph_fit_bucket_policy_single_compile_and_parity(devices):
+    """Satellite (ROADMAP open item): ComputationGraph.fit(bucket_policy=)
+    pads the ragged tail with masked loss — one compiled train program per
+    epoch, same math as the unbucketed run (MLN parity)."""
+    batches = _ragged_batches()  # 64, 64, 22
+    plain = _graph(seed=5)
+    plain.fit(batches, num_epochs=2)
+    bucketed = _graph(seed=5)
+    bucketed.fit(batches, num_epochs=2, bucket_policy=True)
+    assert bucketed.compile_watch.compiles("train") == 1, \
+        bucketed.compile_watch.as_dict()
+    assert bucketed.compile_watch.dispatches("train") == 6
+    assert plain.compile_watch.compiles("train") == 2  # 64-row + 22-row
+    for a, b in zip(jax.tree_util.tree_leaves(plain.params),
+                    jax.tree_util.tree_leaves(bucketed.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_graph_fit_prefetch_bitwise_identical(devices):
+    batches = _ragged_batches(n=128, batch=32)
+    plain = _graph(seed=7)
+    plain.fit(batches, num_epochs=2)
+    pre = _graph(seed=7)
+    pre.fit(batches, num_epochs=2, prefetch=True)
+    for a, b in zip(jax.tree_util.tree_leaves(plain.params),
+                    jax.tree_util.tree_leaves(pre.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pad_multi_dataset_masks(devices):
+    """pad_multi_dataset fabricates a per-output labels mask with the same
+    rules as pad_dataset, and the bucketed graph fit consumes MultiDataSets
+    directly."""
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    from deeplearning4j_tpu.perf import pad_multi_dataset
+    rng = np.random.default_rng(1)
+    mds = MultiDataSet([rng.random((5, 4), np.float32)],
+                       [np.eye(3, dtype=np.float32)[rng.integers(0, 3, 5)]])
+    p = pad_multi_dataset(mds, 8)
+    assert p.num_examples() == 8
+    np.testing.assert_array_equal(p.labels_masks[0],
+                                  [1, 1, 1, 1, 1, 0, 0, 0])
+    assert p.features_masks is None
+    # sequence output with an existing labels mask: zero-padded rows
+    seq = MultiDataSet([rng.random((2, 6, 4), np.float32)],
+                       [rng.random((2, 6, 3), np.float32)],
+                       features_masks=[np.ones((2, 6), np.float32)],
+                       labels_masks=[np.ones((2, 6), np.float32)])
+    ps = pad_multi_dataset(seq, 4)
+    np.testing.assert_array_equal(ps.features_masks[0][2:], 1.0)
+    np.testing.assert_array_equal(ps.labels_masks[0][2:], 0.0)
+    # graph fit over MultiDataSets under a bucket policy == DataSet path
+    batches = _ragged_batches()
+    mbatches = [MultiDataSet.from_dataset(d) for d in batches]
+    g1 = _graph(seed=9)
+    g1.fit(batches, num_epochs=1, bucket_policy=True)
+    g2 = _graph(seed=9)
+    g2.fit(mbatches, num_epochs=1, bucket_policy=True)
+    assert g2.compile_watch.compiles("train") == 1
+    for a, b in zip(jax.tree_util.tree_leaves(g1.params),
+                    jax.tree_util.tree_leaves(g2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 # ------------------------------------------------------------ stats plumbing
