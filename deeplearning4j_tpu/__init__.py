@@ -31,3 +31,4 @@ from deeplearning4j_tpu.perf import (  # noqa: F401
     DevicePrefetchIterator,
 )
 from deeplearning4j_tpu.checkpoint import CheckpointManager  # noqa: F401
+from deeplearning4j_tpu import analysis  # noqa: F401

@@ -1,0 +1,46 @@
+"""Ahead-of-compile static analysis.
+
+Three cooperating passes, all runnable before (or without) any XLA compile:
+
+- ``validation``: pure-Python shape/dtype inference over
+  ``MultiLayerConfiguration`` layer lists and
+  ``ComputationGraphConfiguration`` DAGs — cycle/dangling-vertex detection,
+  conv/pooling geometry, merge/element-wise agreement, RNN time-axis
+  consistency, loss-vs-label compatibility — with error messages that name
+  the offending layer and both shapes. Exposed as ``conf.validate()`` and
+  run automatically in ``init()`` (opt-out via ``init(validate=False)`` or
+  ``DL4J_TPU_VALIDATE=0``). ``eval_shape_check=True`` cross-checks every
+  prediction against ``jax.eval_shape`` of the real forward pass, so the
+  pure-Python inference can never silently drift from real tracing.
+
+- ``trace_check``: a context manager wrapping a fit/predict call that
+  reports trace-time hazards — host-device sync points (implicit
+  ``float()``/``bool()``/``np.asarray`` on device arrays), recompile storms
+  (fed from ``perf.CompileWatch``), and large constants captured by closure
+  that should be arguments. Findings surface through ``TrainingStats``
+  counters and ``ParallelInference.stats()``.
+
+- ``lint``: an AST-based framework linter (``tools/run_lint.py`` CLI) with
+  repo-specific rules: no jnp computation at module import time, no
+  ``time.*``/``random.*`` inside jitted code paths, benchmark timing must
+  sync before reading the clock, and a lock-order checker that flags
+  inconsistent lock-acquisition orderings as deadlock risk. Runs over the
+  whole package as a tier-1 test (``tests/test_lint.py``).
+"""
+
+from deeplearning4j_tpu.analysis.validation import (  # noqa: F401
+    ConfigValidationError,
+    ValidationIssue,
+    validate_graph,
+    validate_multilayer,
+)
+from deeplearning4j_tpu.analysis.trace_check import (  # noqa: F401
+    TraceHazard,
+    TraceReport,
+    trace_check,
+)
+from deeplearning4j_tpu.analysis.lint import (  # noqa: F401
+    LintViolation,
+    lint_file,
+    lint_paths,
+)

@@ -1,0 +1,395 @@
+"""Framework linter: repo-specific AST rules that encode TPU discipline.
+
+Rules (waivable per line with ``# lint: disable=DLT00X`` or per file with
+``# lint: disable-file=DLT00X``):
+
+- **DLT001 module-level-jnp**: no ``jnp.``/``jax.numpy``/``lax.`` computation
+  at module import time (module or class scope, decorators, default args).
+  Import-time device work initializes the backend before configs are read,
+  serializes startup behind compiles, and breaks ``JAX_PLATFORMS`` forcing.
+
+- **DLT002 impure-in-jit**: no ``time.*`` clocks or host ``random.*`` /
+  ``np.random.*`` calls inside jit-traced code paths (functions decorated
+  with / passed to ``jax.jit``, ``lax.scan``/``while_loop``/``fori_loop``/
+  ``cond``, ``vmap``, ``grad``, ``shard_map``, ...). These run ONCE at trace
+  time and freeze into the compiled program as constants — the classic
+  silent "my noise is the same every step" bug.
+
+- **DLT003 bench-timing-sync**: in benchmark/tooling files (``bench*``,
+  ``*perf*``, ``tools/``), a function that reads the wall clock twice must
+  also synchronize (``block_until_ready``/``device_get``/``np.asarray``/
+  ``float(...)``/``.item()``) — JAX dispatch is asynchronous, so an
+  unsynced stopwatch measures dispatch latency, not execution.
+
+- **DLT004 lock-order**: extracts nested lock-acquisition orderings per
+  class and flags a pair of locks taken in opposite orders by different
+  methods as deadlock risk (the ``parallel/`` + ``checkpoint/`` subsystems
+  are lock-heavy and multi-threaded).
+
+Adding a rule: write a ``_rule_xxx(tree, src, path) -> List[LintViolation]``
+function and register it in ``_RULES``; tests in ``tests/test_lint.py``
+seed a fixture violating the rule and assert it fires.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = ["LintViolation", "lint_file", "lint_paths", "DEFAULT_TARGETS"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LintViolation:
+    file: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self):
+        return f"{self.file}:{self.line}: {self.rule} {self.message}"
+
+
+# --------------------------------------------------------------- utilities
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jnp.zeros' for Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """local name -> fully qualified module path, for top-level imports."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def _resolve(dotted: Optional[str], aliases: Dict[str, str]) -> str:
+    """Expand the leading alias of a dotted path to its import target."""
+    if not dotted:
+        return ""
+    head, _, rest = dotted.partition(".")
+    base = aliases.get(head, head)
+    return f"{base}.{rest}" if rest else base
+
+
+_JNP_ROOTS = ("jax.numpy", "jax.lax", "jax.random")
+
+
+def _is_jnp_call(call: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
+    q = _resolve(_dotted(call.func), aliases)
+    if any(q == r or q.startswith(r + ".") for r in _JNP_ROOTS):
+        return q
+    return None
+
+
+# ------------------------------------------------------------------ DLT001
+def _rule_module_level_jnp(tree, src, path) -> List[LintViolation]:
+    aliases = _import_aliases(tree)
+    out: List[LintViolation] = []
+
+    def scan_import_time(nodes: Iterable[ast.AST]):
+        for node in nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # decorators + default args evaluate at import; the body not
+                scan_import_time(node.decorator_list)
+                scan_import_time(d for d in node.args.defaults)
+                scan_import_time(d for d in node.args.kw_defaults if d)
+                continue
+            if isinstance(node, ast.Lambda):
+                continue  # body is deferred
+            if isinstance(node, ast.Call):
+                q = _is_jnp_call(node, aliases)
+                if q:
+                    out.append(LintViolation(
+                        path, node.lineno, "DLT001",
+                        f"'{q}(...)' runs at module import time — device "
+                        "work at import initializes the backend early and "
+                        "serializes startup; move it into a function"))
+                    continue  # one finding per outermost offending call
+            for child in ast.iter_child_nodes(node):
+                scan_import_time([child])
+
+    scan_import_time(tree.body)
+    return out
+
+
+# ------------------------------------------------------------------ DLT002
+_TRANSFORMS = (
+    "jax.jit", "jit", "jax.pmap", "pmap", "jax.vmap", "vmap",
+    "jax.grad", "grad", "jax.value_and_grad", "value_and_grad",
+    "jax.lax.scan", "lax.scan", "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.fori_loop", "lax.fori_loop", "jax.lax.cond", "lax.cond",
+    "jax.lax.map", "lax.map", "jax.checkpoint", "jax.remat",
+    "jax.eval_shape", "shard_map", "jax.experimental.shard_map.shard_map",
+)
+
+_IMPURE = {
+    "time.time": "wall clock", "time.perf_counter": "wall clock",
+    "time.monotonic": "wall clock", "time.process_time": "wall clock",
+    "datetime.datetime.now": "wall clock", "datetime.datetime.utcnow":
+    "wall clock",
+    "random.random": "host RNG", "random.randint": "host RNG",
+    "random.uniform": "host RNG", "random.gauss": "host RNG",
+    "random.choice": "host RNG", "random.shuffle": "host RNG",
+    "random.sample": "host RNG", "random.randrange": "host RNG",
+    "numpy.random": "host RNG",  # prefix match for np.random.*
+}
+
+
+def _impure_reason(q: str) -> Optional[str]:
+    if q in _IMPURE:
+        return _IMPURE[q]
+    if q.startswith("numpy.random."):
+        return "host RNG"
+    return None
+
+
+def _rule_impure_in_jit(tree, src, path) -> List[LintViolation]:
+    aliases = _import_aliases(tree)
+
+    # 1) names of functions handed to a tracing transform anywhere
+    traced_names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            q = _resolve(_dotted(node.func), aliases)
+            short = _dotted(node.func) or ""
+            if q in _TRANSFORMS or short in _TRANSFORMS:
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    if isinstance(arg, ast.Name):
+                        traced_names.add(arg.id)
+                    elif isinstance(arg, ast.Attribute):
+                        traced_names.add(arg.attr)
+
+    def is_jit_decorated(fn) -> bool:
+        for dec in fn.decorator_list:
+            d = dec.func if isinstance(dec, ast.Call) else dec
+            q = _resolve(_dotted(d), aliases)
+            if q in _TRANSFORMS or (_dotted(d) or "") in _TRANSFORMS:
+                return True
+            # functools.partial(jax.jit, ...)
+            if isinstance(dec, ast.Call) and q.endswith("partial"):
+                for a in dec.args:
+                    if _resolve(_dotted(a), aliases) in _TRANSFORMS:
+                        return True
+        return False
+
+    out: List[LintViolation] = []
+    seen_bodies: Set[int] = set()
+
+    def scan_traced_body(fn: ast.AST, origin: str):
+        if id(fn) in seen_bodies:
+            return
+        seen_bodies.add(id(fn))
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                q = _resolve(_dotted(node.func), aliases)
+                reason = _impure_reason(q)
+                if reason:
+                    out.append(LintViolation(
+                        path, node.lineno, "DLT002",
+                        f"'{q}(...)' ({reason}) inside jit-traced "
+                        f"'{origin}' — runs once at trace time and freezes "
+                        "into the compiled program; thread it in as an "
+                        "argument (or use jax.random)"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in traced_names or is_jit_decorated(node):
+                scan_traced_body(node, node.name)
+    for node in ast.walk(tree):  # lambdas passed inline to a transform
+        if isinstance(node, ast.Call):
+            q = _resolve(_dotted(node.func), aliases)
+            short = _dotted(node.func) or ""
+            if q in _TRANSFORMS or short in _TRANSFORMS:
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    if isinstance(arg, ast.Lambda):
+                        scan_traced_body(arg, "<lambda>")
+    return out
+
+
+# ------------------------------------------------------------------ DLT003
+_CLOCKS = ("time.perf_counter", "time.time", "time.monotonic")
+_SYNCS = ("block_until_ready", "device_get", "item", "asarray", "array",
+          "float", "tolist")
+
+
+def _is_bench_file(path: str) -> bool:
+    base = os.path.basename(path)
+    return ("bench" in base or "perf" in base or "profile" in base
+            or f"{os.sep}tools{os.sep}" in path or path.startswith("tools/"))
+
+
+def _rule_bench_sync(tree, src, path) -> List[LintViolation]:
+    if not _is_bench_file(path):
+        return []
+    aliases = _import_aliases(tree)
+    out: List[LintViolation] = []
+
+    def direct_body(fn):
+        """All nodes of fn except nested function bodies."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        clock_lines = []
+        has_sync = False
+        for node in direct_body(fn):
+            if isinstance(node, ast.Call):
+                q = _resolve(_dotted(node.func), aliases)
+                if q in _CLOCKS:
+                    clock_lines.append(node.lineno)
+                name = (node.func.attr if isinstance(node.func, ast.Attribute)
+                        else node.func.id if isinstance(node.func, ast.Name)
+                        else "")
+                if name in _SYNCS:
+                    has_sync = True
+        if len(clock_lines) >= 2 and not has_sync:
+            out.append(LintViolation(
+                path, min(clock_lines), "DLT003",
+                f"function '{fn.name}' reads the clock {len(clock_lines)}x "
+                "without a device sync (block_until_ready/np.asarray/"
+                "float(...)) — async dispatch means the stopwatch measures "
+                "nothing"))
+    return out
+
+
+# ------------------------------------------------------------------ DLT004
+def _rule_lock_order(tree, src, path) -> List[LintViolation]:
+    out: List[LintViolation] = []
+
+    def lock_name(expr) -> Optional[str]:
+        # `self.<attr>` where the attr smells like a lock
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                and "lock" in expr.attr.lower():
+            return expr.attr
+        return None
+
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        # (outer, inner) -> [(method, line)]
+        edges: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
+
+        def collect(nodes, held: List[str], method: str):
+            for node in nodes:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue  # nested defs run later, with unknown holds
+                if isinstance(node, ast.With):
+                    acquired = []
+                    for item in node.items:
+                        ln = lock_name(item.context_expr)
+                        if ln is not None:
+                            for h in held + acquired:
+                                edges.setdefault((h, ln), []).append(
+                                    (method, node.lineno))
+                            acquired.append(ln)
+                    collect(node.body, held + acquired, method)
+                    continue
+                collect(ast.iter_child_nodes(node), held, method)
+
+        for meth in cls.body:
+            if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                collect(meth.body, [], meth.name)
+
+        reported = set()
+        for (a, b), sites in edges.items():
+            if (b, a) in edges and (b, a) not in reported and a != b:
+                reported.add((a, b))
+                m1, l1 = sites[0]
+                m2, l2 = edges[(b, a)][0]
+                out.append(LintViolation(
+                    path, l1, "DLT004",
+                    f"class '{cls.name}' acquires locks in inconsistent "
+                    f"order: '{m1}' takes {a} -> {b} (line {l1}) but "
+                    f"'{m2}' takes {b} -> {a} (line {l2}) — deadlock risk "
+                    "under concurrent callers; pick one global order"))
+    return out
+
+
+# ----------------------------------------------------------------- harness
+_RULES = (
+    _rule_module_level_jnp,
+    _rule_impure_in_jit,
+    _rule_bench_sync,
+    _rule_lock_order,
+)
+
+
+def _waived(v: LintViolation, lines: List[str], file_waivers: Set[str]) -> bool:
+    if v.rule in file_waivers:
+        return True
+    if 1 <= v.line <= len(lines):
+        text = lines[v.line - 1]
+        if "lint: disable" in text and (v.rule in text
+                                        or text.rstrip().endswith("disable")):
+            return True
+    return False
+
+
+def lint_file(path: str, src: Optional[str] = None) -> List[LintViolation]:
+    if src is None:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [LintViolation(path, e.lineno or 0, "DLT000",
+                              f"syntax error: {e.msg}")]
+    lines = src.splitlines()
+    file_waivers = {
+        part.strip().split()[0].rstrip(")")
+        for line in lines if "lint: disable-file=" in line
+        for part in line.split("lint: disable-file=")[1].split(",")
+        if part.strip()
+    }
+    out: List[LintViolation] = []
+    for rule in _RULES:
+        out.extend(rule(tree, src, path))
+    return sorted((v for v in out if not _waived(v, lines, file_waivers)),
+                  key=lambda v: (v.file, v.line, v.rule))
+
+
+def lint_paths(paths: Iterable[str]) -> List[LintViolation]:
+    out: List[LintViolation] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs if d != "__pycache__"]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.extend(lint_file(os.path.join(root, f)))
+        elif p.endswith(".py"):
+            out.extend(lint_file(p))
+    return out
+
+
+def DEFAULT_TARGETS(repo_root: str) -> List[str]:
+    """The tier-1 lint surface: the package, the benches, the tools."""
+    return [os.path.join(repo_root, "deeplearning4j_tpu"),
+            os.path.join(repo_root, "bench.py"),
+            os.path.join(repo_root, "tools")]

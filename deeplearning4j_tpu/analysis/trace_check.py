@@ -1,0 +1,278 @@
+"""Trace-hazard detection: the performance bugs that fail *silently*.
+
+On TPU three classes of mistake never raise — they just make the step loop
+slow, and from the host they all look identical:
+
+1. **Host-device sync points**: an implicit ``float(loss)`` /
+   ``bool(x > 0)`` / ``np.asarray(out)`` on a device array blocks the host
+   until the device catches up, collapsing the async dispatch pipeline.
+2. **Recompile hazards**: the same jitted program re-traced because a
+   static shape or dtype shifted (a ragged final batch, a drifting mask
+   layout). One recompile is multi-second; a storm looks like a slow loop.
+3. **Closure-captured constants**: a large array captured by closure is
+   baked into the compiled program as a constant — re-tracing on every new
+   value and bloating the executable — when it should be an argument.
+
+``trace_check()`` wraps any fit/predict region and reports all three::
+
+    with analysis.trace_check(model=net) as report:
+        net.fit(data)
+    print(report.summary())
+
+Sync points are caught by interposing the device array type's conversion
+protocol (``__float__``/``__bool__``/``__int__``/``__index__``) plus the
+``np.asarray``/``np.array``/``jax.device_get`` entry points; recompiles and
+captured constants come from ``perf.CompileWatch``'s dispatch-observer
+hook, which sees every watched jitted call with its arguments (constants
+are found by re-tracing the function with ``jax.make_jaxpr`` — shape-only,
+no FLOPs — and inspecting the jaxpr's consts).
+
+Findings surface through ``TrainingStats`` counters (pass ``stats=``) and
+``ParallelInference.stats()`` (the report attaches to the wrapped model as
+``model.last_trace_report``). The monitor patches process-global entry
+points: it is a diagnostic tool for one region at a time, not an
+always-on profiler (nesting raises).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["TraceHazard", "TraceReport", "trace_check"]
+
+
+@dataclasses.dataclass
+class TraceHazard:
+    """kind: 'sync' | 'recompile' | 'captured-const'."""
+
+    kind: str
+    where: str     # caller file:line for syncs; jit key for the others
+    detail: str
+    count: int = 1
+
+    def __str__(self):
+        times = f" (x{self.count})" if self.count > 1 else ""
+        return f"[{self.kind}] {self.where}{times}: {self.detail}"
+
+
+class TraceReport:
+    def __init__(self):
+        self.hazards: List[TraceHazard] = []
+
+    def _by_kind(self, kind: str) -> List[TraceHazard]:
+        return [h for h in self.hazards if h.kind == kind]
+
+    @property
+    def sync_points(self) -> List[TraceHazard]:
+        return self._by_kind("sync")
+
+    @property
+    def recompiles(self) -> List[TraceHazard]:
+        return self._by_kind("recompile")
+
+    @property
+    def captured_constants(self) -> List[TraceHazard]:
+        return self._by_kind("captured-const")
+
+    def counts(self) -> Dict[str, int]:
+        """Aggregate counters, TrainingStats/stats()-shaped."""
+        return {
+            "trace_sync_points": sum(h.count for h in self.sync_points),
+            "trace_recompiles": sum(h.count for h in self.recompiles),
+            "trace_captured_consts": len(self.captured_constants),
+        }
+
+    def to_stats(self, stats) -> None:
+        """Record the aggregate counters into a parallel.TrainingStats."""
+        for k, v in self.counts().items():
+            stats.set_counter(k, v)
+
+    def summary(self) -> str:
+        if not self.hazards:
+            return "trace_check: no hazards detected"
+        lines = [f"trace_check: {len(self.hazards)} finding(s)"]
+        lines.extend(f"  {h}" for h in self.hazards)
+        return "\n".join(lines)
+
+
+def _caller() -> str:
+    """file:line of the frame that triggered a sync, skipping this module,
+    numpy and jax internals."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not (fn.endswith("trace_check.py") or "/numpy/" in fn
+                or "/jax/" in fn or "/jaxlib/" in fn):
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+_active_lock = threading.Lock()
+_active: Optional["trace_check"] = None
+
+
+class trace_check:
+    """Context manager; see module docstring.
+
+    Parameters:
+    - ``model``: attach the report as ``model.last_trace_report`` so
+      ``ParallelInference.stats()`` surfaces the hazard counts.
+    - ``stats``: a ``parallel.TrainingStats`` to receive the counters.
+    - ``check_constants``: re-trace each newly compiled program (shape-only)
+      to find large closure-captured constants. Costs one extra trace per
+      compile inside the region.
+    - ``const_min_bytes``: constants smaller than this are considered
+      scalars/config, not hazards.
+    """
+
+    def __init__(self, model=None, stats=None, check_constants: bool = True,
+                 const_min_bytes: int = 4096):
+        self._model = model
+        self._stats = stats
+        self._check_constants = check_constants
+        self._const_min_bytes = const_min_bytes
+        self.report = TraceReport()
+        self._sync_events: Dict[Tuple[str, str], int] = {}
+        self._compile_counts: Dict[str, int] = {}
+        self._events_lock = threading.Lock()
+        self._suppress = threading.local()
+        self._restores: list = []
+
+    # ------------------------------------------------------------- recording
+    def _record_sync(self, op: str):
+        if getattr(self._suppress, "on", False):
+            return
+        where = _caller()
+        with self._events_lock:
+            key = (where, op)
+            self._sync_events[key] = self._sync_events.get(key, 0) + 1
+
+    def _on_dispatch(self, key, fn, args, kwargs, compiled):
+        if not compiled:
+            return
+        with self._events_lock:
+            self._compile_counts[key] = self._compile_counts.get(key, 0) \
+                + compiled
+        if self._check_constants:
+            self._find_captured_consts(key, fn, args, kwargs)
+
+    def _find_captured_consts(self, key, fn, args, kwargs):
+        import jax
+        wrapped = getattr(fn, "__wrapped__", None)
+        if wrapped is None:
+            return
+        self._suppress.on = True
+        try:
+            closed = jax.make_jaxpr(wrapped)(*args, **kwargs)
+            for const in closed.consts:
+                nbytes = getattr(const, "nbytes", 0) or 0
+                if nbytes >= self._const_min_bytes:
+                    self.report.hazards.append(TraceHazard(
+                        "captured-const", key,
+                        f"array constant shape={tuple(const.shape)} "
+                        f"dtype={const.dtype} ({int(nbytes)} B) is baked "
+                        "into the compiled program — captured by closure at "
+                        "trace time; pass it as an argument so new values "
+                        "don't force a re-trace"))
+        except Exception:
+            pass  # donated/deleted buffers, non-jaxprable fns: best-effort
+        finally:
+            self._suppress.on = False
+
+    # ------------------------------------------------------------- patching
+    def _patch_attr(self, obj, name: str, wrapper):
+        orig = getattr(obj, name)
+        setattr(obj, name, wrapper(orig))
+        self._restores.append((obj, name, orig))
+
+    def _install(self):
+        import jax
+        import numpy as np
+
+        arr_t = type(jax.numpy.zeros(()))  # concrete device array type
+        record = self._record_sync
+
+        def conv_wrapper(op, orig):
+            def w(self_arr, *a, **k):
+                record(op)
+                return orig(self_arr, *a, **k)
+            return w
+
+        for dunder in ("__float__", "__bool__", "__int__", "__index__"):
+            if hasattr(arr_t, dunder):
+                try:
+                    self._patch_attr(arr_t, dunder,
+                                     lambda o, d=dunder: conv_wrapper(d, o))
+                except (TypeError, AttributeError):
+                    pass  # non-patchable array type on this backend
+
+        def np_wrapper(op, orig):
+            def w(a, *rest, **k):
+                if isinstance(a, jax.Array):
+                    record(op)
+                return orig(a, *rest, **k)
+            return w
+
+        self._patch_attr(np, "asarray", lambda o: np_wrapper("np.asarray", o))
+        self._patch_attr(np, "array", lambda o: np_wrapper("np.array", o))
+        self._patch_attr(jax, "device_get",
+                         lambda o: np_wrapper("jax.device_get", o))
+
+        from deeplearning4j_tpu.perf import compile_watch
+        compile_watch.add_dispatch_observer(self._on_dispatch)
+        self._restores.append(
+            (compile_watch, "remove_dispatch_observer", self._on_dispatch))
+
+    def _uninstall(self):
+        from deeplearning4j_tpu.perf import compile_watch
+        for obj, name, orig in reversed(self._restores):
+            if name == "remove_dispatch_observer":
+                compile_watch.remove_dispatch_observer(orig)
+            else:
+                try:
+                    setattr(obj, name, orig)
+                except (TypeError, AttributeError):
+                    pass
+        self._restores = []
+
+    # ------------------------------------------------------------- protocol
+    def __enter__(self) -> TraceReport:
+        global _active
+        with _active_lock:
+            if _active is not None:
+                raise RuntimeError(
+                    "trace_check regions cannot nest (the monitor patches "
+                    "process-global entry points)")
+            _active = self
+        self._install()
+        return self.report
+
+    def __exit__(self, exc_type, exc, tb):
+        global _active
+        self._uninstall()
+        with _active_lock:
+            _active = None
+        with self._events_lock:
+            for (where, op), count in sorted(self._sync_events.items()):
+                self.report.hazards.append(TraceHazard(
+                    "sync", where,
+                    f"implicit host-device sync via {op} on a device array "
+                    "— blocks the host until the device drains; hoist out "
+                    "of the step loop or batch the reads", count=count))
+            for key, compiles in sorted(self._compile_counts.items()):
+                if compiles >= 2:
+                    self.report.hazards.append(TraceHazard(
+                        "recompile", key,
+                        f"program compiled {compiles}x inside one region — "
+                        "static shapes/dtypes are shifting between calls; "
+                        "pad to a bucket ladder (perf.BucketPolicy) or fix "
+                        "the dtype drift", count=compiles))
+        if self._stats is not None:
+            self.report.to_stats(self._stats)
+        if self._model is not None:
+            self._model.last_trace_report = self.report
+        return False

@@ -54,19 +54,42 @@ def _proj(p, x):
 def _attend(params, x, mask, n_heads: int, causal: bool):
     """Shared multi-head attention core over nested q/k/v/o param groups.
     Uses the Pallas flash kernel when the shapes meet its block constraints
-    and there is no padding mask; the dense path is reference_attention."""
+    and there is no padding mask; the dense path is reference_attention.
+
+    Which path the compiled program took is observable: every trace bumps a
+    ``perf.CompileWatch`` counter (``attention.flash`` /
+    ``attention.flash_fallback`` / ``attention.dense``) via
+    ``bump_active`` — landing on the owning model's watch when traced
+    inside one of its jitted programs, and on ``GLOBAL`` always — surfaced
+    by ``ParallelInference.stats()``. A serving fleet silently running the
+    dense path instead of the Pallas kernel shows up in its stats rather
+    than only as a latency regression. Counters tick at TRACE time (once
+    per compiled program), not per dispatch."""
+    import jax
+
     from deeplearning4j_tpu.parallel.ring_attention import (
         flash_self_attention, reference_attention,
     )
+    from deeplearning4j_tpu.perf.compile_watch import bump_active
+
     q = _heads(_proj(params["q"], x), n_heads)
     k = _heads(_proj(params["k"], x), n_heads)
     v = _heads(_proj(params["v"], x), n_heads)
     out = None
     if mask is None and q.shape[2] >= 128:
-        try:  # flash on TPU; falls back to the dense reference off-TPU
+        on_tpu = jax.default_backend() == "tpu"
+        try:
             out = flash_self_attention(q, k, v, causal=causal)
-        except ValueError:  # kernel block constraints (shape-dependent)
+            bump_active("attention.flash" if on_tpu
+                        else "attention.flash_unavailable")
+        except ValueError:
+            # kernel block constraints (shape-dependent): the silent perf
+            # cliff this counter exists for — the Pallas kernel was
+            # eligible but got skipped
+            bump_active("attention.flash_fallback")
             out = None
+    else:
+        bump_active("attention.dense")  # masked/short sequence: by design
     if out is None:
         out = reference_attention(q, k, v, causal=causal, key_mask=mask)
     return _proj(params["o"], _unheads(out))

@@ -390,6 +390,32 @@ class ComputationGraphConfiguration:
             out[name] = (obj, inputs)
         return out
 
+    # ---- static analysis (analysis/validation.py) ----
+    def validate(self, *, eval_shape_check: bool = False, batch: int = 2,
+                 labels_shapes=None, raise_on_error: bool = True):
+        """Ahead-of-compile DAG validation: cycle / dangling-vertex /
+        unknown-reference detection, merge/element-wise rank+shape
+        agreement, per-layer shape inference with vertex-named messages.
+        ``eval_shape_check=True`` cross-checks against ``jax.eval_shape``
+        of the traced DAG. Returns the issue list; raises
+        :class:`analysis.ConfigValidationError` on errors unless
+        ``raise_on_error=False``."""
+        from deeplearning4j_tpu.analysis.validation import (
+            ConfigValidationError, validate_graph)
+        issues = validate_graph(
+            self, eval_shape_check=eval_shape_check, batch=batch,
+            labels_shapes=labels_shapes)
+        errors = [i for i in issues if i.severity == "error"]
+        if errors and raise_on_error:
+            raise ConfigValidationError(errors)
+        return issues
+
+    def memory_report(self, minibatch: int = 32):
+        """Analytic per-vertex parameter + activation memory (no device
+        allocation). See nn/memory.py::conf_memory_report."""
+        from deeplearning4j_tpu.nn.memory import conf_memory_report
+        return conf_memory_report(self, minibatch=minibatch)
+
     # ---- serde ----
     def to_json(self) -> str:
         d = {

@@ -111,6 +111,36 @@ class MultiLayerConfiguration:
             cur = layer.output_type(cur) if cur is not None else None
         return out
 
+    # ---- static analysis (analysis/validation.py) ----
+    def validate(self, *, eval_shape_check: bool = False, batch: int = 2,
+                 labels_shape=None, raise_on_error: bool = True):
+        """Ahead-of-compile validation: shape/dtype inference over the layer
+        stack with layer-named error messages (conv geometry, n_in/n_out
+        wiring, unknown activations/losses, time-axis consistency,
+        loss-vs-label compatibility). ``eval_shape_check=True`` additionally
+        cross-checks every prediction against ``jax.eval_shape`` of the real
+        forward pass. Returns the issue list (warnings included); raises
+        :class:`analysis.ConfigValidationError` on error-severity issues
+        unless ``raise_on_error=False``."""
+        from deeplearning4j_tpu.analysis.validation import (
+            ConfigValidationError, validate_multilayer)
+        issues = validate_multilayer(
+            self, eval_shape_check=eval_shape_check, batch=batch,
+            labels_shape=labels_shape)
+        errors = [i for i in issues if i.severity == "error"]
+        if errors and raise_on_error:
+            raise ConfigValidationError(errors)
+        return issues
+
+    def memory_report(self, input_type=None, minibatch: int = 32):
+        """Analytic per-layer parameter + activation memory for this
+        configuration (no device allocation: parameter shapes come from
+        ``jax.eval_shape`` of each layer's init). See
+        nn/memory.py::conf_memory_report."""
+        from deeplearning4j_tpu.nn.memory import conf_memory_report
+        return conf_memory_report(self, input_type=input_type,
+                                  minibatch=minibatch)
+
     # ---- serde (reference toJson/fromJson) ----
     def to_json(self) -> str:
         from deeplearning4j_tpu.nn.conf.preprocessors import preprocessor_to_dict

@@ -83,7 +83,16 @@ class ComputationGraph:
         self.compile_watch = CompileWatch("ComputationGraph")
 
     # ------------------------------------------------------------------ init
-    def init(self, seed: Optional[int] = None) -> "ComputationGraph":
+    def init(self, seed: Optional[int] = None,
+             validate: Optional[bool] = None) -> "ComputationGraph":
+        """Initialize params/optimizer state. Runs ``conf.validate()`` first
+        (vertex-named errors before any XLA trace); opt out per call with
+        ``validate=False`` or process-wide with ``DL4J_TPU_VALIDATE=0``."""
+        if validate is None:
+            import os
+            validate = os.environ.get("DL4J_TPU_VALIDATE", "1") != "0"
+        if validate:
+            self.conf.validate()
         rng = jax.random.key(self.conf.seed if seed is None else seed)
         params, state = {}, {}
         for name in self.order:

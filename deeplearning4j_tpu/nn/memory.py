@@ -157,6 +157,89 @@ def get_memory_report(net, minibatch: int = 32,
         compiled=compiled)
 
 
+def _abstract_layer_stats(layer, it, key, itemsize: int):
+    """(num_params, param_bytes, abstract_params) for one layer WITHOUT
+    allocating: parameter shapes come from jax.eval_shape of the layer's
+    init — the same shape-inference-first approach as analysis/validation."""
+    p, _ = jax.eval_shape(lambda k: layer.init(k, it, jnp.float32), key)
+    leaves = jax.tree_util.tree_leaves(p)
+    n_params = int(sum(int(np.prod(a.shape)) for a in leaves))
+    p_bytes = int(sum(int(np.prod(a.shape)) * itemsize for a in leaves))
+    return n_params, p_bytes, p
+
+
+def conf_memory_report(conf, input_type=None, minibatch: int = 32) -> MemoryReport:
+    """Memory report for a CONFIGURATION — no network, no device buffers.
+
+    Consumes the shape-inference pass (``layer_input_types`` /
+    ``vertex_input_types``): per-layer parameter counts/bytes come from
+    ``jax.eval_shape`` of each layer's init, activations from the inferred
+    ``InputType`` chain, and updater state from ``jax.eval_shape`` of the
+    optax transform's init over the abstract params. Accepts a
+    MultiLayerConfiguration (``input_type`` may override the configured one)
+    or a ComputationGraphConfiguration."""
+    itemsize = jnp.dtype(conf.dtype).itemsize
+    key = jax.random.key(0)
+    reports: List[LayerMemoryReport] = []
+    total_act = 0
+    total_params = 0
+    updater_bytes = 0
+
+    if hasattr(conf, "layers"):  # MultiLayerConfiguration
+        if input_type is not None:
+            conf = dataclasses.replace(conf, input_type=input_type)
+        if conf.input_type is None:
+            raise ValueError("memory_report requires an input_type")
+        types = conf.layer_input_types()
+        entries = [(f"{i}_{type(l).__name__}", l, it)
+                   for i, (l, it) in enumerate(zip(conf.wired_layers(), types))]
+        per_layer_updater = [
+            (getattr(l, "updater", None) or conf.updater) for l in conf.layers]
+    else:  # ComputationGraphConfiguration
+        types_map = conf.vertex_input_types()
+        entries = []
+        per_layer_updater = []
+        wired = conf.wired_vertices()
+        for name in conf.topological_order():
+            obj = wired[name][0]
+            if hasattr(obj, "init"):  # Layer
+                entries.append((name, obj, types_map[name][0]))
+                per_layer_updater.append(
+                    getattr(obj, "updater", None) or conf.updater)
+
+    for (name, layer, it), upd in zip(entries, per_layer_updater):
+        n_params, p_bytes, p_abs = _abstract_layer_stats(layer, it, key,
+                                                         itemsize)
+        try:
+            out_t = layer.output_type(it)
+        except ValueError:
+            out_t = it
+        act_bytes, act_shape = _input_type_bytes(out_t, itemsize)
+        reports.append(LayerMemoryReport(
+            name=name, layer_class=type(layer).__name__,
+            num_params=n_params, param_bytes=p_bytes,
+            activation_bytes_per_example=int(act_bytes),
+            activation_shape=act_shape))
+        total_act += act_bytes * minibatch
+        total_params += p_bytes
+        if n_params:
+            opt = jax.eval_shape(upd.to_optax().init, p_abs)
+            updater_bytes += int(sum(
+                int(np.prod(a.shape)) * itemsize
+                for a in jax.tree_util.tree_leaves(opt)
+                if hasattr(a, "shape")))
+
+    return MemoryReport(
+        model_class=type(conf).__name__,
+        minibatch=minibatch,
+        dtype=conf.dtype,
+        layers=reports,
+        total_param_bytes=int(total_params),
+        total_activation_bytes=int(total_act),
+        updater_state_bytes=int(updater_bytes),
+        compiled=None)
+
+
 def _compiled_step_stats(net, minibatch: int, first_input_type) -> Optional[dict]:
     try:
         conf = net.conf

@@ -95,8 +95,19 @@ class MultiLayerNetwork:
         self._restored_from = None
 
     # ------------------------------------------------------------------ init
-    def init(self, seed: Optional[int] = None) -> "MultiLayerNetwork":
-        """Initialize params/optimizer state (reference MultiLayerNetwork.init :541)."""
+    def init(self, seed: Optional[int] = None,
+             validate: Optional[bool] = None) -> "MultiLayerNetwork":
+        """Initialize params/optimizer state (reference MultiLayerNetwork.init :541).
+
+        Runs ``conf.validate()`` first so misconfigurations fail here with a
+        layer-named message instead of seconds later inside an XLA trace.
+        Opt out per call with ``validate=False`` or process-wide with
+        ``DL4J_TPU_VALIDATE=0``."""
+        if validate is None:
+            import os
+            validate = os.environ.get("DL4J_TPU_VALIDATE", "1") != "0"
+        if validate:
+            self.conf.validate()
         rng = jax.random.key(self.conf.seed if seed is None else seed)
         types = self.conf.layer_input_types()
         params, state = [], []

@@ -255,6 +255,20 @@ class ParallelInference:
         if cw is not None:
             out["model_compiles"] = cw.compiles()
             out["model_dispatches"] = cw.dispatches()
+        # attention kernel-path counters (nn/conf/attention.py _attend): a
+        # serving model silently skipping the Pallas flash kernel
+        # (attention.flash_fallback > 0) is visible here, not just as a
+        # latency regression. Read from THIS model's watch (bump_active
+        # routes trace-time events to the tracing model), so two models in
+        # one process never misattribute each other's kernel paths.
+        if cw is not None:
+            att = cw.counters("attention.")
+            if att:
+                out["attention"] = att
+        # last analysis.trace_check report for this model, if one ran
+        report = getattr(self.model, "last_trace_report", None)
+        if report is not None:
+            out["trace_hazards"] = report.counts()
         return out
 
     # -------------------------------------------------------- batched path

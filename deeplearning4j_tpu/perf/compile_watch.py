@@ -32,19 +32,36 @@ def _cache_size(fn) -> Optional[int]:
 
 class CompileWatch:
     """Per-key compile/dispatch counters. Thread-safe (the inference worker
-    dispatches from its own thread)."""
+    dispatches from its own thread). Besides compile/dispatch pairs, freeform
+    integer ``counters`` record one-off trace-time events (e.g. the attention
+    layer falling back from the Pallas flash kernel to the dense path)."""
 
     def __init__(self, name: str = ""):
         self.name = name
         self._lock = threading.Lock()
         self._compiles: Dict[str, int] = {}
         self._dispatches: Dict[str, int] = {}
+        self._counters: Dict[str, int] = {}
 
     # ------------------------------------------------------------ recording
     def _record(self, key: str, compiles: int, dispatches: int):
         with self._lock:
             self._compiles[key] = self._compiles.get(key, 0) + compiles
             self._dispatches[key] = self._dispatches.get(key, 0) + dispatches
+
+    def bump(self, counter: str, by: int = 1):
+        """Increment a freeform event counter."""
+        with self._lock:
+            self._counters[counter] = self._counters.get(counter, 0) + int(by)
+
+    def counter(self, counter: str) -> int:
+        with self._lock:
+            return self._counters.get(counter, 0)
+
+    def counters(self, prefix: str = "") -> Dict[str, int]:
+        with self._lock:
+            return {k: v for k, v in self._counters.items()
+                    if k.startswith(prefix)}
 
     def wrap(self, fn, key: str) -> "_WatchedFunction":
         """Wrap a jitted callable; every call records into this watch AND
@@ -68,10 +85,11 @@ class CompileWatch:
         with self._lock:
             self._compiles.clear()
             self._dispatches.clear()
+            self._counters.clear()
 
     def as_dict(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "compiles": sum(self._compiles.values()),
                 "dispatches": sum(self._dispatches.values()),
                 "by_key": {k: {"compiles": self._compiles.get(k, 0),
@@ -79,9 +97,46 @@ class CompileWatch:
                            for k in sorted(set(self._compiles)
                                            | set(self._dispatches))},
             }
+            if self._counters:
+                out["counters"] = dict(self._counters)
+            return out
 
 
 GLOBAL = CompileWatch("global")
+
+# Watches of the watched call currently tracing/executing on THIS thread.
+# Layer code that wants to record a trace-time event against "whichever
+# model is being traced right now" (e.g. the attention flash-kernel path
+# choice) calls bump_active(): the event lands on the owning model's watch
+# when the trace runs inside a wrapped call, and on GLOBAL always — so
+# per-model stats never misattribute another model's traces.
+_active = threading.local()
+
+
+def bump_active(counter: str, by: int = 1) -> None:
+    sinks = getattr(_active, "sinks", None) or (GLOBAL,)
+    for sink in sinks:
+        sink.bump(counter, by)
+    if GLOBAL not in sinks:
+        GLOBAL.bump(counter, by)
+
+
+# Dispatch observers: callables invoked after every watched call with
+# (key, fn, args, kwargs, compiles). analysis.trace_check registers one to
+# attribute recompiles and closure-captured constants to live dispatches.
+# Observer errors are swallowed — observability must never break the step.
+_observers: list = []
+
+
+def add_dispatch_observer(cb) -> None:
+    _observers.append(cb)
+
+
+def remove_dispatch_observer(cb) -> None:
+    try:
+        _observers.remove(cb)
+    except ValueError:
+        pass
 
 
 class _WatchedFunction:
@@ -111,7 +166,12 @@ class _WatchedFunction:
 
     def __call__(self, *args, **kwargs):
         before = _cache_size(self._fn)
-        out = self._fn(*args, **kwargs)
+        prev = getattr(_active, "sinks", None)
+        _active.sinks = self._sinks
+        try:
+            out = self._fn(*args, **kwargs)
+        finally:
+            _active.sinks = prev
         after = _cache_size(self._fn)
         if before is not None and after is not None:
             compiled = max(0, after - before)
@@ -122,6 +182,11 @@ class _WatchedFunction:
                 self._seen_sigs.add(sig)
         for sink in self._sinks:
             sink._record(self._key, compiled, 1)
+        for cb in list(_observers):
+            try:
+                cb(self._key, self._fn, args, kwargs, compiled)
+            except Exception:
+                pass
         return out
 
     def __getattr__(self, name):  # lower/trace/cache introspection pass through

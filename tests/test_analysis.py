@@ -1,0 +1,396 @@
+"""Tests for the analysis/ static-analysis subsystem: config validation
+(shape inference + jax.eval_shape cross-check), trace-hazard detection, and
+the stats wiring. The framework linter has its own suite (test_lint.py)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import analysis
+from deeplearning4j_tpu.analysis import ConfigValidationError
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.convolutional import (ConvolutionLayer,
+                                                      SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.graph import (ComputationGraphConfiguration,
+                                              ElementWiseVertex, MergeVertex)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.recurrent import LSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _mlp_conf(**layer_kw):
+    kw = {"n_out": 16, "activation": "relu", **layer_kw}
+    return (NeuralNetConfiguration.builder().list()
+            .layer(DenseLayer(**kw))
+            .layer(OutputLayer(n_out=4))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+
+
+def _graph_conf(vertices, inputs=("in",), outputs=("out",),
+                input_types=(InputType.feed_forward(8),)):
+    return ComputationGraphConfiguration(
+        network_inputs=tuple(inputs), vertices=vertices,
+        network_outputs=tuple(outputs), input_types=tuple(input_types))
+
+
+class TestMultiLayerValidation:
+    def test_valid_conf_is_clean(self):
+        assert _mlp_conf().validate() == []
+
+    def test_conv_kernel_exceeds_input_names_layer(self):
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(ConvolutionLayer(name="stem", n_out=8,
+                                        kernel_size=(9, 9)))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.convolutional(6, 6, 1))
+                .build())
+        with pytest.raises(ConfigValidationError) as ei:
+            conf.validate()
+        msg = str(ei.value)
+        assert "stem" in msg                      # names the layer
+        assert "kernel 9" in msg and "input size 6" in msg  # both shapes
+
+    def test_pooling_geometry_checked(self):
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(SubsamplingLayer(name="pool", kernel_size=(8, 8),
+                                        stride=(8, 8)))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.convolutional(4, 4, 2))
+                .build())
+        with pytest.raises(ConfigValidationError, match="pool"):
+            conf.validate()
+
+    def test_unknown_activation_named(self):
+        with pytest.raises(ConfigValidationError) as ei:
+            _mlp_conf(name="d0", activation="rleu").validate()
+        assert "d0" in str(ei.value) and "rleu" in str(ei.value)
+
+    def test_unknown_loss(self):
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(OutputLayer(name="head", n_out=4, loss="msee"))
+                .set_input_type(InputType.feed_forward(8)).build())
+        with pytest.raises(ConfigValidationError) as ei:
+            conf.validate()
+        assert "head" in str(ei.value) and "msee" in str(ei.value)
+
+    def test_n_out_missing(self):
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(DenseLayer(name="empty"))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(8)).build())
+        with pytest.raises(ConfigValidationError, match="empty"):
+            conf.validate()
+
+    def test_n_in_mismatch(self):
+        with pytest.raises(ConfigValidationError, match="n_in=99"):
+            _mlp_conf(name="d", n_in=99).validate()
+
+    def test_dropout_out_of_range(self):
+        with pytest.raises(ConfigValidationError, match="dropout"):
+            _mlp_conf(dropout=1.5).validate()
+
+    def test_output_layer_midstack(self):
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(OutputLayer(name="early", n_out=4))
+                .layer(DenseLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(8)).build())
+        issues = conf.validate(raise_on_error=False)
+        assert any(i.rule == "output-layer-position" and "early" in i.layer
+                   for i in issues)
+
+    def test_sequence_layer_on_ff_input(self):
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(LSTM(name="rnn1", n_out=8))
+                .layer(RnnOutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(8)).build())
+        with pytest.raises(ConfigValidationError) as ei:
+            conf.validate()
+        assert "rnn1" in str(ei.value) and "sequence" in str(ei.value)
+
+    def test_labels_shape_compatibility(self):
+        conf = _mlp_conf()
+        assert conf.validate(labels_shape=(32, 4)) == []
+        with pytest.raises(ConfigValidationError, match="labels"):
+            conf.validate(labels_shape=(32, 7))
+        # sequence output wants (batch, time, n_out)
+        rconf = (NeuralNetConfiguration.builder().list()
+                 .layer(LSTM(n_out=8))
+                 .layer(RnnOutputLayer(n_out=3))
+                 .set_input_type(InputType.recurrent(4, 10)).build())
+        assert rconf.validate(labels_shape=(2, 10, 3)) == []
+        with pytest.raises(ConfigValidationError, match="labels"):
+            rconf.validate(labels_shape=(2, 3))
+
+    def test_loss_activation_pairing_warns(self):
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(OutputLayer(n_out=4, loss="mcxent",
+                                   activation="identity"))
+                .set_input_type(InputType.feed_forward(8)).build())
+        issues = conf.validate()  # warnings never raise
+        assert any(i.rule == "loss-activation" and i.severity == "warning"
+                   for i in issues)
+
+    def test_init_runs_validation_with_opt_out(self):
+        conf = _mlp_conf(activation="rleu")
+        with pytest.raises(ConfigValidationError):
+            MultiLayerNetwork(conf).init()
+        # opt-out flag: init succeeds (the bad name would only explode at
+        # the first forward trace)
+        net = MultiLayerNetwork(conf).init(validate=False)
+        assert net.params is not None
+
+    def test_init_env_opt_out(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_VALIDATE", "0")
+        net = MultiLayerNetwork(_mlp_conf(activation="rleu")).init()
+        assert net.params is not None
+
+    def test_eval_shape_cross_check_clean(self):
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                        convolution_mode="same"))
+                .layer(SubsamplingLayer())
+                .layer(DenseLayer(n_out=16, activation="relu"))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.convolutional(8, 8, 1)).build())
+        assert conf.validate(eval_shape_check=True) == []
+
+    def test_eval_shape_drift_detected(self):
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class LyingDense(DenseLayer):
+            """output_type deliberately disagrees with apply."""
+
+            def output_type(self, it):
+                return InputType.feed_forward(self.n_out + 1)
+
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(LyingDense(name="liar", n_out=4))
+                .set_input_type(InputType.feed_forward(8)).build())
+        issues = conf.validate(eval_shape_check=True, raise_on_error=False)
+        drift = [i for i in issues if i.rule == "eval-shape-drift"]
+        assert drift and "liar" in drift[0].layer
+
+
+class TestGraphValidation:
+    def test_cycle_names_vertices(self):
+        conf = _graph_conf({
+            "a": (DenseLayer(n_out=4), ("in",)),
+            "b": (ElementWiseVertex(), ("a", "c")),
+            "c": (DenseLayer(n_out=4), ("b",)),
+            "out": (OutputLayer(n_out=2), ("c",)),
+        })
+        with pytest.raises(ConfigValidationError) as ei:
+            conf.validate()
+        assert "cycle" in str(ei.value) and "'b'" in str(ei.value)
+
+    def test_unknown_input_named(self):
+        conf = _graph_conf({
+            "a": (DenseLayer(n_out=4), ("in", "ghost")),
+            "out": (OutputLayer(n_out=2), ("a",)),
+        })
+        with pytest.raises(ConfigValidationError) as ei:
+            conf.validate()
+        assert "'a'" in str(ei.value) and "ghost" in str(ei.value)
+
+    def test_conv_geometry_in_graph_names_vertex(self):
+        conf = _graph_conf(
+            {"conv1": (ConvolutionLayer(n_out=4, kernel_size=(9, 9)),
+                       ("in",)),
+             "out": (OutputLayer(n_out=2), ("conv1",))},
+            input_types=(InputType.convolutional(6, 6, 1),))
+        with pytest.raises(ConfigValidationError) as ei:
+            conf.validate()
+        assert "conv1" in str(ei.value) and "kernel 9" in str(ei.value)
+
+    def test_merge_rank_mismatch_names_vertex_and_shapes(self):
+        conf = _graph_conf(
+            {"m": (MergeVertex(), ("i1", "i2")),
+             "out": (OutputLayer(n_out=2), ("m",))},
+            inputs=("i1", "i2"),
+            input_types=(InputType.feed_forward(8),
+                         InputType.recurrent(8, 5)))
+        with pytest.raises(ConfigValidationError) as ei:
+            conf.validate()
+        msg = str(ei.value)
+        assert "'m'" in msg and "ff(size=8)" in msg and "rnn" in msg
+
+    def test_elementwise_shape_mismatch(self):
+        conf = _graph_conf(
+            {"add": (ElementWiseVertex(op="add"), ("i1", "i2")),
+             "out": (OutputLayer(n_out=2), ("add",))},
+            inputs=("i1", "i2"),
+            input_types=(InputType.feed_forward(8),
+                         InputType.feed_forward(12)))
+        with pytest.raises(ConfigValidationError) as ei:
+            conf.validate()
+        assert "'add'" in str(ei.value) and "size=12" in str(ei.value)
+
+    def test_cycle_core_separated_from_downstream(self):
+        conf = _graph_conf({
+            "a": (DenseLayer(n_out=4), ("in",)),
+            "b": (ElementWiseVertex(), ("a", "c")),
+            "c": (DenseLayer(n_out=4), ("b",)),
+            "out": (OutputLayer(n_out=2), ("c",)),
+        })
+        issues = conf.validate(raise_on_error=False)
+        cyc = [i for i in issues if i.rule == "cycle"]
+        down = [i for i in issues if i.rule == "cycle-downstream"]
+        # 'out' depends on the b<->c cycle but is not part of it
+        assert cyc and "['b', 'c']" in cyc[0].message
+        assert down and "out" in down[0].message
+
+    def test_self_loop_detected_as_cycle(self):
+        conf = _graph_conf({
+            "a": (ElementWiseVertex(), ("in", "a")),
+            "out": (OutputLayer(n_out=2), ("a",)),
+        })
+        with pytest.raises(ConfigValidationError) as ei:
+            conf.validate()
+        assert "cycle" in str(ei.value) and "'a'" in str(ei.value)
+
+    def test_dangling_vertex_is_warning(self):
+        conf = _graph_conf({
+            "a": (DenseLayer(n_out=4), ("in",)),
+            "deadend": (DenseLayer(n_out=4), ("a",)),
+            "out": (OutputLayer(n_out=2), ("a",)),
+        })
+        issues = conf.validate()  # warnings do not raise
+        assert any(i.rule == "dangling-vertex" and "deadend" in i.layer
+                   for i in issues)
+
+    def test_output_not_loss_layer(self):
+        conf = _graph_conf({
+            "a": (DenseLayer(n_out=4), ("in",)),
+        }, outputs=("a",))
+        with pytest.raises(ConfigValidationError, match="output/loss"):
+            conf.validate()
+
+    def test_graph_eval_shape_cross_check_clean(self):
+        conf = _graph_conf(
+            {"d1": (DenseLayer(n_out=8, activation="relu"), ("in",)),
+             "d2": (DenseLayer(n_out=8, activation="tanh"), ("in",)),
+             "m": (MergeVertex(), ("d1", "d2")),
+             "out": (OutputLayer(n_out=3), ("m",))})
+        assert conf.validate(eval_shape_check=True) == []
+
+
+class TestTraceCheck:
+    def _small_net(self):
+        return MultiLayerNetwork(_mlp_conf()).init()
+
+    def _batch(self, rng, bs):
+        x = rng.random((bs, 8), np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, bs)]
+        return DataSet(x, y)
+
+    def test_sync_and_recompile_detection(self):
+        net = self._small_net()
+        rng = np.random.default_rng(0)
+        from deeplearning4j_tpu.parallel.stats import TrainingStats
+        stats = TrainingStats()
+        with analysis.trace_check(model=net, stats=stats) as report:
+            for bs in (4, 6, 4, 6):      # shifting batch shape -> recompile
+                net.fit(self._batch(rng, bs))
+                net.score()              # float() on device array -> sync
+        assert report.sync_points, report.summary()
+        assert any(h.count >= 2 for h in report.recompiles), report.summary()
+        assert stats.counters["trace_sync_points"] >= 4
+        assert stats.counters["trace_recompiles"] >= 2
+        assert net.last_trace_report is report
+
+    def test_monitor_restores_on_exit(self):
+        net = self._small_net()
+        rng = np.random.default_rng(1)
+        with analysis.trace_check() as report:
+            net.fit(self._batch(rng, 4))
+            net.score()
+        n = sum(h.count for h in report.sync_points)
+        net.score_dataset(self._batch(rng, 4))  # outside: not recorded
+        float(np.float32(1.0))
+        assert sum(h.count for h in report.sync_points) == n
+
+    def test_captured_constant_detected(self):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.perf.compile_watch import CompileWatch
+        big = jnp.asarray(np.ones((256, 256), np.float32))
+        watched = CompileWatch("t").wrap(jax.jit(lambda x: x @ big),
+                                         "closure_fn")
+        with analysis.trace_check() as report:
+            watched(jnp.ones((4, 256)))
+        consts = report.captured_constants
+        assert consts and "closure_fn" in consts[0].where
+        assert "(262144 B)" in consts[0].detail
+
+    def test_nesting_raises(self):
+        with analysis.trace_check():
+            with pytest.raises(RuntimeError, match="nest"):
+                with analysis.trace_check():
+                    pass
+
+    def test_surfaces_in_parallel_inference_stats(self):
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+        net = self._small_net()
+        pi = ParallelInference(net, batch_limit=4,
+                               inference_mode="sequential")
+        with analysis.trace_check(model=net):
+            np_out = pi.output(np.zeros((3, 8), np.float32))
+            assert np_out.shape[0] == 3
+        st = pi.stats()
+        assert "trace_hazards" in st
+        assert set(st["trace_hazards"]) == {
+            "trace_sync_points", "trace_recompiles", "trace_captured_consts"}
+        pi.shutdown()
+
+
+class TestAttentionFallbackCounter:
+    def test_dense_and_flash_paths_counted(self):
+        from deeplearning4j_tpu.nn.conf.attention import SelfAttentionLayer
+        from deeplearning4j_tpu.perf.compile_watch import GLOBAL
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(SelfAttentionLayer(n_out=16, n_heads=2))
+                .layer(RnnOutputLayer(n_out=2))
+                .set_input_type(InputType.recurrent(16, 128)).build())
+        net = MultiLayerNetwork(conf).init()
+        before = dict(GLOBAL.counters("attention."))
+        # t=128, no mask: flash-eligible — off-TPU this is the
+        # 'flash_unavailable' dense fallback; on TPU 'flash'
+        net.output(np.zeros((2, 128, 16), np.float32))
+        after = dict(GLOBAL.counters("attention."))
+        assert sum(after.values()) > sum(before.get(k, 0)
+                                         for k in after), (before, after)
+        grew = {k for k in after
+                if after[k] > before.get(k, 0)}
+        assert grew & {"attention.flash", "attention.flash_unavailable",
+                       "attention.flash_fallback"}
+        # masked call takes the dense path by design
+        before = dict(GLOBAL.counters("attention."))
+        net.output(np.zeros((2, 128, 16), np.float32),
+                   features_mask=np.ones((2, 128), np.float32))
+        after = dict(GLOBAL.counters("attention."))
+        assert after.get("attention.dense", 0) > before.get(
+            "attention.dense", 0)
+
+    def test_attention_counters_in_serving_stats_are_per_model(self):
+        from deeplearning4j_tpu.nn.conf.attention import SelfAttentionLayer
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(SelfAttentionLayer(n_out=8, n_heads=2))
+                .layer(RnnOutputLayer(n_out=2))
+                .set_input_type(InputType.recurrent(8, 128)).build())
+        net = MultiLayerNetwork(conf).init()
+        pi = ParallelInference(net, inference_mode="sequential")
+        pi.output(np.zeros((2, 128, 8), np.float32))
+        st = pi.stats()
+        assert "attention" in st and st["attention"]
+        pi.shutdown()
+        # a SECOND attention model tracing in the same process must not
+        # leak into the first model's serving stats (bump_active routes
+        # trace-time events to the model being traced)
+        other = MultiLayerNetwork(conf).init()
+        other.output(np.zeros((2, 128, 8), np.float32))
+        assert pi.stats()["attention"] == st["attention"]
+        assert other.compile_watch.counters("attention.")

@@ -401,3 +401,39 @@ class TestConverterTail:
                                "atrous_rate": 2, "padding": "same",
                                "use_bias": False, "activation": "linear"}, {})
         assert spec1.layer.dilation == 2
+
+
+class TestImportedConfigsValidate:
+    """Satellite of the analysis/ subsystem: every keras_import output is a
+    framework config the static validator accepts — import drift (a
+    converter emitting inconsistent wiring) fails here pre-compile."""
+
+    def test_sequential_import_validates(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((12, 12, 1)),
+            keras.layers.Conv2D(4, (3, 3), activation="relu"),
+            keras.layers.MaxPooling2D((2, 2)),
+            keras.layers.Flatten(),
+            keras.layers.Dense(16, activation="relu"),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        path = _save(m, tmp_path, "v.h5", loss="categorical_crossentropy")
+        net = import_keras_sequential_model_and_weights(path)
+        issues = net.conf.validate(eval_shape_check=True,
+                                   raise_on_error=False)
+        errors = [i for i in issues if i.severity == "error"]
+        assert errors == [], "\n".join(str(i) for i in errors)
+
+    def test_functional_import_validates(self, tmp_path):
+        inp = keras.layers.Input((8,))
+        h = keras.layers.Dense(16, activation="relu")(inp)
+        h2 = keras.layers.Dense(16, activation="relu")(h)
+        added = keras.layers.add([h, h2])
+        out = keras.layers.Dense(2, activation="softmax")(added)
+        m = keras.Model(inp, out)
+        path = _save(m, tmp_path, "f.h5", loss="categorical_crossentropy")
+        net = import_keras_model_and_weights(path)
+        issues = net.conf.validate(eval_shape_check=True,
+                                   raise_on_error=False)
+        errors = [i for i in issues if i.severity == "error"]
+        assert errors == [], "\n".join(str(i) for i in errors)

@@ -64,3 +64,54 @@ def test_compiled_step_stats():
     assert rep.compiled["argument_bytes"] >= batch_bytes
     assert rep.compiled["temp_bytes"] >= 0
     assert "Compiled train step" in rep.to_string()
+
+
+def test_conf_memory_report_matches_initialized_net():
+    """conf.memory_report(): the config-level analytic report (shape
+    inference + jax.eval_shape of each layer's init — no device buffers)
+    agrees exactly with the counts of a really-initialized network."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(learning_rate=1e-3)).list()
+            .layer(DenseLayer(n_out=20, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(10))
+            .build())
+    rep = conf.memory_report(minibatch=16)
+    assert rep.compiled is None                      # no compile happened
+    assert [l.layer_class for l in rep.layers] == ["DenseLayer",
+                                                   "OutputLayer"]
+    assert rep.layers[0].num_params == 220 and rep.layers[1].num_params == 63
+    assert rep.total_param_bytes == (220 + 63) * 4
+    assert rep.total_activation_bytes == (80 + 12) * 16
+    # Adam: mu + nu per param, derived via eval_shape of the optax init
+    assert 2 * rep.total_param_bytes <= rep.updater_state_bytes \
+        <= 2 * rep.total_param_bytes + 64
+    # cross-check against the real network
+    net = MultiLayerNetwork(conf).init()
+    live = get_memory_report(net, minibatch=16, compile_step=False)
+    assert sum(l.num_params for l in rep.layers) == net.num_params()
+    assert rep.total_param_bytes == live.total_param_bytes
+    assert rep.total_activation_bytes == live.total_activation_bytes
+
+
+def test_conf_memory_report_input_type_override():
+    conf = (NeuralNetConfiguration.builder().list()
+            .layer(DenseLayer(n_out=4))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    rep = conf.memory_report(input_type=InputType.feed_forward(32),
+                             minibatch=2)
+    # dense re-wired 32->4: (32*4 + 4) params
+    assert rep.layers[0].num_params == 32 * 4 + 4
+
+
+def test_conf_memory_report_for_graph():
+    """Graph configs report per-vertex (parameterless vertices excluded)."""
+    from deeplearning4j_tpu.models import ResNet50
+    conf = ResNet50(num_classes=7, input_shape=(32, 32, 3)).conf()
+    rep = conf.memory_report(minibatch=4)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    net = ComputationGraph(conf).init()
+    assert sum(l.num_params for l in rep.layers) == net.num_params()
+    assert rep.total_param_bytes == net.num_params() * 4

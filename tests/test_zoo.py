@@ -194,3 +194,52 @@ def test_init_pretrained_without_url_raises():
     from deeplearning4j_tpu.models.zoo import ZooModel
     with pytest.raises(FileNotFoundError, match="pretrained"):
         LeNet(num_classes=10).init_pretrained()
+
+
+# ---------------------------------------------------------- static analysis
+def _zoo_builders():
+    """Every zoo model at CI-sized inputs (catches zoo drift for free:
+    any config edit that breaks shape inference or diverges from real
+    tracing fails here before a single XLA compile)."""
+    from deeplearning4j_tpu.models import GoogLeNet, InceptionResNetV1, \
+        FaceNetNN4Small2
+    return [
+        ("LeNet", lambda: LeNet(num_classes=10).conf()),
+        ("SimpleCNN",
+         lambda: SimpleCNN(num_classes=5, input_shape=(32, 32, 3)).conf()),
+        ("AlexNet",
+         lambda: AlexNet(num_classes=7, input_shape=(96, 96, 3)).conf()),
+        ("VGG16",
+         lambda: VGG16(num_classes=10, input_shape=(64, 64, 3)).conf()),
+        ("VGG19",
+         lambda: VGG19(num_classes=10, input_shape=(64, 64, 3)).conf()),
+        ("ResNet50",
+         lambda: ResNet50(num_classes=11, input_shape=(64, 64, 3)).conf()),
+        ("Darknet19",
+         lambda: Darknet19(num_classes=6, input_shape=(64, 64, 3)).conf()),
+        ("TinyYOLO",
+         lambda: TinyYOLO(num_classes=3, input_shape=(64, 64, 3)).conf()),
+        ("TextGenerationLSTM",
+         lambda: TextGenerationLSTM(total_unique_characters=30,
+                                    units=32).conf()),
+        ("GoogLeNet",
+         lambda: GoogLeNet(num_classes=10, input_shape=(64, 64, 3)).conf()),
+        ("InceptionResNetV1",
+         lambda: InceptionResNetV1(num_classes=4,
+                                   input_shape=(96, 96, 3)).conf()),
+        ("FaceNetNN4Small2",
+         lambda: FaceNetNN4Small2(num_classes=3,
+                                  input_shape=(96, 96, 3)).conf()),
+    ]
+
+
+@pytest.mark.parametrize("name,builder", _zoo_builders(),
+                         ids=[n for n, _ in _zoo_builders()])
+def test_zoo_config_validates_and_agrees_with_eval_shape(name, builder):
+    """conf.validate() passes for every zoo builder, INCLUDING the
+    jax.eval_shape cross-check: the pure-Python shape inference and the
+    real trace agree on every layer/vertex activation shape."""
+    conf = builder()
+    issues = conf.validate(eval_shape_check=True, raise_on_error=False)
+    errors = [i for i in issues if i.severity == "error"]
+    assert errors == [], "\n".join(str(i) for i in errors)

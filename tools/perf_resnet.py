@@ -28,7 +28,7 @@ def bench(run_one, fetch, steps=20, warmup=3):
     for _ in range(warmup):
         run_one()
     fetch()
-    t0 = time.perf_counter()
+    t0 = time.perf_counter()  # lint: disable=DLT003 (fetch() is the sync: reads the last step's output)
     for _ in range(steps):
         run_one()
     fetch()

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Run the framework linter (analysis/lint.py) over the repo.
+
+Usage:
+    python tools/run_lint.py [path ...]
+
+With no arguments lints the tier-1 surface: ``deeplearning4j_tpu/``,
+``bench.py`` and ``tools/``. Exits 1 on any violation — the same contract
+``tests/test_lint.py`` enforces in CI. Waive a finding inline with
+``# lint: disable=DLT00X`` (or file-wide with ``# lint: disable-file=...``)
+and a short justification.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.analysis.lint import DEFAULT_TARGETS, lint_paths  # noqa: E402
+
+
+def main(argv) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    targets = argv[1:] or DEFAULT_TARGETS(repo_root)
+    violations = lint_paths(targets)
+    for v in violations:
+        print(v)
+    n = len(violations)
+    print(f"lint: {n} violation{'s' if n != 1 else ''} in "
+          f"{len(targets)} target(s)")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
