@@ -148,12 +148,13 @@ def _model_fwd_flops_per_image(net) -> float:
     for this graph is ~7.7e9). Methodology change recorded in the emitted
     ``note`` field (r4).
     """
-    from deeplearning4j_tpu.nn.conf.convolutional import ConvolutionLayer
+    from deeplearning4j_tpu.nn.conf.convolutional import (
+        ConvolutionLayer, FusedConvBNActivation)
     total = 0.0
     for name in net.order:
         obj, _ = net.vertices[name]
         it = net.vertex_input_types[name][0]
-        if isinstance(obj, ConvolutionLayer):
+        if isinstance(obj, (ConvolutionLayer, FusedConvBNActivation)):
             from deeplearning4j_tpu.nn.conf.convolutional import _pair
             out_t = obj.output_type(it)
             kh, kw = _pair(obj.kernel_size)
@@ -168,7 +169,7 @@ def _model_fwd_flops_per_image(net) -> float:
 
 
 def _bench_resnet50_once(dtype: str, batch: int, side: int, warmup: int,
-                         steps: int):
+                         steps: int, fused: bool = False):
     import dataclasses as _dc
 
     import jax
@@ -179,6 +180,8 @@ def _bench_resnet50_once(dtype: str, batch: int, side: int, warmup: int,
     conf = _dc.replace(
         ResNet50(num_classes=1000, input_shape=(side, side, 3)).conf(),
         dtype=dtype)
+    if fused:
+        conf = conf.fused()  # conv→BN→act fused blocks (perf/fusion.py)
     net = ComputationGraph(conf).init()
     fwd_flops = _model_fwd_flops_per_image(net)
     step = net._get_jitted("train")
@@ -251,6 +254,54 @@ def bench_resnet50():
              mfu=round(achieved / peak, 4),
              fwd_gflops_per_img=round(fwd_flops / 1e9, 2),
              note=notes[dtype] + " " + _REPS_NOTE)
+
+
+def bench_resnet50_fusion():
+    """Fusion on/off ablation for the north-star model (perf/fusion.py):
+    the same bf16 train step with the conv→BN→act chains left unfused vs
+    rewritten into FusedConvBNActivation blocks whose custom-VJP BN
+    backward recomputes x-hat instead of re-reading activation-sized
+    saves. Emits one metric per mode (``..._fusion_{off,on}``) plus the
+    jaxpr-derived training-activation-bytes each mode hands its backward —
+    the HBM-traffic number the fusion attacks. Thresholds only on full
+    runs (BASELINE notes): this hook exists so the next on-chip run
+    records the attribution."""
+    import dataclasses as _dc
+
+    from deeplearning4j_tpu.models import ResNet50
+    from deeplearning4j_tpu.perf.fusion import training_activation_bytes
+
+    if QUICK:
+        batch, side, warmup, steps = 2, 64, 1, 2
+    else:
+        batch = int(os.environ.get("BENCH_RESNET_BATCH", "128"))
+        side, warmup, steps = 224, 6, 30
+    peak = float(os.environ.get("BENCH_PEAK_FLOPS", "197e12"))
+    conf = _dc.replace(
+        ResNet50(num_classes=1000, input_shape=(side, side, 3)).conf(),
+        dtype="bfloat16")
+    act_bytes = {}
+    for fused, tag in ((False, "off"), (True, "on")):
+        c = conf.fused() if fused else conf
+        try:
+            act_bytes[tag] = int(training_activation_bytes(c,
+                                                           minibatch=batch))
+        except Exception:
+            act_bytes[tag] = None
+    for fused, tag in ((False, "off"), (True, "on")):
+        imgs_per_sec, fwd_flops = _bench_resnet50_once(
+            "bfloat16", batch, side, warmup, steps, fused=fused)
+        achieved = imgs_per_sec * 3 * fwd_flops
+        emit(f"resnet50_imagenet_train_imgs_per_sec_per_chip_fusion_{tag}",
+             imgs_per_sec, "imgs/sec", "resnet50", batch=batch,
+             dtype="bfloat16", fusion=tag,
+             achieved_tflops=round(achieved / 1e12, 2),
+             mfu=round(achieved / peak, 4),
+             training_activation_bytes=act_bytes[tag],
+             note="fusion ablation (perf/fusion.py): identical math within "
+                  "fp tolerance; training_activation_bytes is the "
+                  "jaxpr-derived fwd->bwd residual set the BN-backward "
+                  "traffic rides on. " + _REPS_NOTE)
 
 
 def bench_graveslstm():
@@ -519,6 +570,7 @@ def main():
     benches = [("lenet", bench_lenet), ("word2vec", bench_word2vec),
                ("charlstm", bench_graveslstm), ("serving", bench_serving),
                ("checkpoint", bench_checkpoint),
+               ("resnet50_fusion", bench_resnet50_fusion),
                ("resnet50", bench_resnet50)]
     only = os.environ.get("BENCH_ONLY")
     if only:

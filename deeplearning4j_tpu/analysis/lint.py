@@ -26,6 +26,13 @@ Rules (waivable per line with ``# lint: disable=DLT00X`` or per file with
   methods as deadlock risk (the ``parallel/`` + ``checkpoint/`` subsystems
   are lock-heavy and multi-threaded).
 
+- **DLT005 serving-bn-fold**: a file that builds a model with
+  ``BatchNormalization`` AND serves it through ``ParallelInference`` —
+  without ever folding (``fold_bn``) — pays per-request BN normalize
+  traffic that ``perf.fusion.fold_bn`` eliminates exactly (and any
+  ``train=True`` call on that serving path would run BN-*train* semantics
+  on request batches). Fold for serving, or waive inline like DLT003.
+
 Adding a rule: write a ``_rule_xxx(tree, src, path) -> List[LintViolation]``
 function and register it in ``_RULES``; tests in ``tests/test_lint.py``
 seed a fixture violating the rule and assert it fires.
@@ -331,12 +338,55 @@ def _rule_lock_order(tree, src, path) -> List[LintViolation]:
     return out
 
 
+# ------------------------------------------------------------------ DLT005
+def _rule_serving_bn_fold(tree, src, path) -> List[LintViolation]:
+    aliases = _import_aliases(tree)
+    pi_lines: List[int] = []
+    has_bn = False
+    has_fold = False
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            d = _dotted(node) or getattr(node, "attr", "") or \
+                getattr(node, "id", "")
+            if "fold_bn" in d:
+                has_fold = True
+        if not isinstance(node, ast.Call):
+            continue
+        q = _resolve(_dotted(node.func), aliases)
+        tail = q.rsplit(".", 1)[-1] if q else ""
+        if tail == "ParallelInference":
+            pi_lines.append(node.lineno)
+            # ParallelInference(..., fold_bn=True) folds internally; an
+            # explicit literal False is NOT a fold — that is exactly the
+            # unfolded serving site the rule exists to catch
+            for kw in node.keywords:
+                if kw.arg == "fold_bn" and not (
+                        isinstance(kw.value, ast.Constant)
+                        and kw.value.value is False):
+                    has_fold = True
+        elif tail == "BatchNormalization":
+            has_bn = True
+        elif "fold_bn" in tail:
+            has_fold = True
+    if not (pi_lines and has_bn) or has_fold:
+        return []
+    return [LintViolation(
+        path, line, "DLT005",
+        "model built with BatchNormalization is served through "
+        "ParallelInference without BN folding — every dispatch re-applies "
+        "the BN normalize (and a train=True call on this path would run "
+        "BN-train semantics on request batches); fold it exactly into the "
+        "conv weights with perf.fusion.fold_bn / "
+        "ParallelInference(fold_bn=True)") for line in pi_lines]
+
+
 # ----------------------------------------------------------------- harness
 _RULES = (
     _rule_module_level_jnp,
     _rule_impure_in_jit,
     _rule_bench_sync,
     _rule_lock_order,
+    _rule_serving_bn_fold,
 )
 
 

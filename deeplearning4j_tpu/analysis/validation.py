@@ -156,6 +156,17 @@ def _check_layer(layer, cur, name: str) -> List[ValidationIssue]:
             break
         target = inner
 
+    # unknown remat policy (the knob lowers to jax.checkpoint at trace
+    # time; a typo would otherwise surface mid-trace)
+    remat = getattr(layer, "remat", None)
+    if remat is not None:
+        from deeplearning4j_tpu.perf.fusion import REMAT_POLICIES
+        if str(remat) not in REMAT_POLICIES:
+            issues.append(ValidationIssue(
+                "unknown-remat", name,
+                f"remat='{remat}' is not a known rematerialization policy "
+                f"(known: {sorted(REMAT_POLICIES)})"))
+
     # sequence layers need a time axis to operate on
     if hasattr(layer, "input_kind") and layer.input_kind() == "rnn" \
             and cur is not None and cur.kind not in ("rnn", "cnn1d"):

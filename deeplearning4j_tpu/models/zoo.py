@@ -31,18 +31,27 @@ class ZooModel:
     def conf(self):
         raise NotImplementedError
 
-    def init(self):
-        """Build + initialize (reference ZooModel.init())."""
+    def init(self, fold_bn: bool = False):
+        """Build + initialize (reference ZooModel.init()). ``fold_bn=True``
+        returns the inference/serving build: every Conv→BatchNorm pair
+        folded into the conv's weights/bias (perf/fusion.fold_bn) so the
+        graph contains no BN at all — exact within fp tolerance against
+        BN-inference output, but NOT trainable (running stats are gone)."""
         from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
         from deeplearning4j_tpu.nn.conf.graph import ComputationGraphConfiguration
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
         from deeplearning4j_tpu.nn.graph import ComputationGraph
         c = self.conf()
         if isinstance(c, MultiLayerConfiguration):
-            return MultiLayerNetwork(c).init()
-        if isinstance(c, ComputationGraphConfiguration):
-            return ComputationGraph(c).init()
-        raise TypeError(type(c))
+            net = MultiLayerNetwork(c).init()
+        elif isinstance(c, ComputationGraphConfiguration):
+            net = ComputationGraph(c).init()
+        else:
+            raise TypeError(type(c))
+        if fold_bn:
+            from deeplearning4j_tpu.perf.fusion import fold_bn as _fold_bn
+            net = _fold_bn(net)
+        return net
 
     def pretrained_checkpoint(self) -> Optional[str]:
         d = os.environ.get("DL4J_TPU_PRETRAINED_DIR")

@@ -385,7 +385,11 @@ _sgns_corpus_cache = {}
 
 def sgns_corpus_macro_step(K: int, W: int, B: int, NB: int):
     """Jitted macro step: NB on-device-generated batches of B pairs, K
-    shared negatives per batch, window w=W. Cached per static config."""
+    shared negatives per batch, window w=W. Cached per static config.
+    The corpus operand may be sentinel-padded (sid=-1) to a canonical
+    length; the true token count and the active-batch quota arrive as
+    device scalars (``true_t``, ``n_active``), so one compiled program
+    serves every segment length up to the padding budget."""
     key_ = (K, W, B, NB)
     fn = _sgns_corpus_cache.get(key_)
     if fn is not None:
@@ -399,21 +403,32 @@ def sgns_corpus_macro_step(K: int, W: int, B: int, NB: int):
     dist_cdf = jnp.asarray(cum, jnp.int32)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def run(syn0, syn1neg, corpus, sid, neg_table, keep, key, lr):
-        T = corpus.shape[0]
+    def run(syn0, syn1neg, corpus, sid, neg_table, keep, key, lr, true_t,
+            n_active):
+        # corpus/sid may be PADDED to the segment budget so every segment
+        # length compiles the same program; ``true_t`` (device scalar) is
+        # the real token count — position sampling and validity use it, so
+        # the sentinel padding (sid = -1) is never sampled or paired.
+        # ``n_active`` (device scalar) masks trailing batches beyond the
+        # segment's pair quota: NB stays static (one compiled scan) while
+        # the trained pair count still tracks the true T.
+        Tpad = corpus.shape[0]
         TT = neg_table.shape[0]
+        true_t = jnp.asarray(true_t, jnp.int32)
+        n_active = jnp.asarray(n_active, jnp.int32)
 
-        def body(carry, k):
+        def body(carry, inp):
             s0, s1 = carry
+            k, bi = inp
             kp, kd, kside, kneg, kkeep = jax.random.split(k, 5)
-            pos = jax.random.randint(kp, (B,), 0, T)
+            pos = jax.random.randint(kp, (B,), 0, true_t)
             d = 1 + jnp.searchsorted(
                 dist_cdf, jax.random.randint(kd, (B,), 0, total),
                 side="right").astype(jnp.int32)
             side = jnp.where(jax.random.bernoulli(kside, 0.5, (B,)), 1, -1)
             cpos = pos + side * d
-            valid = (cpos >= 0) & (cpos < T)
-            cposc = jnp.clip(cpos, 0, T - 1)
+            valid = (cpos >= 0) & (cpos < true_t) & (bi < n_active)
+            cposc = jnp.clip(cpos, 0, Tpad - 1)
             valid &= sid[pos] == sid[cposc]
             # corpus/sid may ship int16 (halved tunnel upload); index math
             # in int32
@@ -455,7 +470,8 @@ def sgns_corpus_macro_step(K: int, W: int, B: int, NB: int):
             return (s0, s1), loss
 
         keys = jax.random.split(key, NB)
-        (syn0, syn1neg), losses = jax.lax.scan(body, (syn0, syn1neg), keys)
+        (syn0, syn1neg), losses = jax.lax.scan(
+            body, (syn0, syn1neg), (keys, jnp.arange(NB, dtype=jnp.int32)))
         return syn0, syn1neg, losses
 
     _sgns_corpus_cache[key_] = run

@@ -23,6 +23,7 @@ from deeplearning4j_tpu.nlp import kernels
 from deeplearning4j_tpu.nlp.vocab import (
     AbstractCache, VocabConstructor, build_huffman, unigram_table,
 )
+from deeplearning4j_tpu.perf.compile_watch import CompileWatch
 
 log = logging.getLogger(__name__)
 
@@ -71,6 +72,10 @@ class SequenceVectors:
         self._rng = np.random.default_rng(seed)
         self.words_processed = 0
         self.loss_history: List[float] = []
+        # compile/dispatch counters for the device-corpus macro step: the
+        # padded-segment scheme promises ONE compiled program for all
+        # full-budget segments (tests assert it here)
+        self.compile_watch = CompileWatch("SequenceVectors")
 
     # ------------------------------------------------------------ vocab/init
     def build_vocab(self, sequences: Iterable[List[str]]):
@@ -334,13 +339,19 @@ class SequenceVectors:
         use_dev = (self.device_corpus if self.device_corpus is not None
                    else (dev_capable and self.sampling == 0))
         if use_dev:
-            token_lists = [t for t in seq_factory()]
-            n_tokens = sum(len(t) for t in token_lists)
+            # decide the gate WITHOUT materializing the corpus: the vocab
+            # pass already counted every in-vocab token, so the device-path
+            # decision is free and the sequence factory streams segment by
+            # segment inside _fit_device_corpus (host RAM stays bounded by
+            # one segment, not the corpus)
             if (self.device_corpus
-                    or n_tokens >= self._DEVICE_CORPUS_MIN_TOKENS):
-                return self._fit_device_corpus(token_lists)
-            # below the gate: reuse the already-tokenized lists on the
-            # host path instead of re-running the tokenizer per epoch
+                    or (self.vocab.total_word_occurrences
+                        >= self._DEVICE_CORPUS_MIN_TOKENS)):
+                return self._fit_device_corpus(seq_factory)
+            # below the gate the corpus is small by definition: tokenize
+            # once and reuse on the host path instead of re-running the
+            # factory per epoch
+            token_lists = [t for t in seq_factory()]
             seq_factory = (lambda lists=token_lists: lists)
         total = self.vocab.total_word_occurrences * self.epochs * self.iterations
         for epoch in range(self.epochs):
@@ -385,19 +396,29 @@ class SequenceVectors:
         if seg:
             yield seg
 
-    def _fit_device_corpus(self, token_lists):
+    def _fit_device_corpus(self, seq_factory):
         """Corpus-resident training (see fit()): per segment of whole
         sentences, upload the encoded indices once (content-hash cached
         across epochs AND across fits on the same corpus) and run ONE
         jitted macro dispatch that generates pairs and negatives on device.
 
+        ``seq_factory`` is consumed LAZILY, one segment at a time — the
+        host never holds more than one segment of token lists, so RAM is
+        bounded by the segment budget regardless of corpus size. Segments
+        are PADDED up to ``_DEVICE_CORPUS_SEG_TOKENS`` with an inert
+        sentinel (sid=-1; the true token count rides along as a device
+        scalar for position sampling/validity), so every segment shares ONE
+        compiled macro program instead of one per distinct length
+        (``self.compile_watch`` counts the compiles).
+
         Pair quota per segment: T*(window+1) sampled pairs — the exact
         expected pair count of the reference's dynamic-window enumeration
         (per position 2*E[r] = window+1 pairs), drawn from the same joint
-        (position, side, offset) distribution by the kernel. Dispatches are
-        async; the only host sync is the per-epoch loss aggregation, so
-        host-side indexing of the next segment overlaps device training of
-        the current one."""
+        (position, side, offset) distribution by the kernel; the static
+        scan length is sized for the budget and trailing batches beyond
+        the quota are masked on device. Dispatches are async; the only
+        host sync is the per-epoch loss aggregation, so host-side indexing
+        of the next segment overlaps device training of the current one."""
         import hashlib
 
         import jax
@@ -408,6 +429,12 @@ class SequenceVectors:
                 self._neg_table.astype(np.int32))
         if self._jax_key is None:
             self._jax_key = jax.random.key(self.seed)
+        # device-resident tables from the FIRST dispatch: a numpy first
+        # step would compile its own donation-less specialization of the
+        # macro program (breaking the one-compile contract) and copy the
+        # tables every step
+        self.syn0 = jnp.asarray(self.syn0)
+        self.syn1 = jnp.asarray(self.syn1)
         keep = None
         if self.sampling:
             counts = np.array([vw.count for vw in self.vocab.vocab_words()],
@@ -428,12 +455,16 @@ class SequenceVectors:
             # many distinct corpora must not pin HBM forever
             cache = self._corpus_dev_cache = {}
         widx = {vw.word: vw.index for vw in self.vocab.vocab_words()}
+        if not callable(seq_factory):
+            seq_factory = (lambda lists=seq_factory: lists)
 
         def first_pass_plan():
             """Index + upload segments lazily, so the caller's dispatch of
-            segment i overlaps (async) with indexing of segment i+1.
+            segment i overlaps (async) with indexing of segment i+1 — and
+            the factory is only ever consumed one segment ahead.
             Boundaries (sid) are part of the cache identity."""
-            for seg in self._segment_token_lists(token_lists):
+            budget = self._DEVICE_CORPUS_SEG_TOKENS
+            for seg in self._segment_token_lists(seq_factory()):
                 flat, sid = self._index_flat(seg, widx)
                 if len(flat) < 2:
                     continue
@@ -441,6 +472,16 @@ class SequenceVectors:
                 sdt = (np.int16 if sid[-1] < 2 ** 15 else np.int32)
                 sid = sid.astype(sdt)
                 T = len(flat)
+                if T < budget:
+                    # pad to the budget with an inert sentinel: sid=-1
+                    # never matches a real sentence id, and the kernel
+                    # samples positions from the TRUE length (shipped as a
+                    # device scalar) — so every <=budget segment compiles
+                    # the SAME macro program regardless of its length
+                    flat = np.concatenate(
+                        [flat, np.zeros(budget - T, flat.dtype)])
+                    sid = np.concatenate(
+                        [sid, np.full(budget - T, -1, sid.dtype)])
                 h = hashlib.sha1(flat.tobytes())
                 h.update(sid.tobytes())
                 hit = cache.get(h.digest())
@@ -449,14 +490,14 @@ class SequenceVectors:
                     while len(cache) >= 1024:  # FIFO bound on pinned HBM
                         cache.pop(next(iter(cache)))
                     cache[h.digest()] = hit
-                # full segments share one compiled program: quota from the
-                # BUDGET, not the exact T (overshoot < 1 sentence). A
-                # segment can only EXCEED the budget via one oversized
-                # sentence — its quota must stay T, never be clamped down
-                budget = self._DEVICE_CORPUS_SEG_TOKENS
-                q = budget if (T <= budget and T * 10 >= budget * 9) else T
-                nb = max(1, -(-(q * (W + 1)) // B))
-                yield hit[0], hit[1], T, nb
+                # static scan length from the padded shape (one program);
+                # the segment's true quota T*(W+1) rides along as n_active
+                # — trailing batches are masked on device. A segment can
+                # only EXCEED the budget via one oversized sentence; it
+                # keeps its own (rare) program
+                nb = max(1, -(-(max(T, budget) * (W + 1)) // B))
+                nvb = min(nb, max(1, -(-(T * (W + 1)) // B)))
+                yield hit[0], hit[1], T, nb, nvb
 
         plan = None  # filled on the first pass; later passes reuse it
         for _epoch in range(self.epochs):
@@ -464,18 +505,22 @@ class SequenceVectors:
             for _ in range(self.iterations):
                 entries = first_pass_plan() if plan is None else plan
                 built = [] if plan is None else None
-                for corpus_dev, sid_dev, T, nb in entries:
+                for corpus_dev, sid_dev, T, nb, nvb in entries:
                     lr = self._lr(total_expected)
-                    step = kernels.sgns_corpus_macro_step(
-                        self.negative, W, B, nb)
+                    step = self.compile_watch.wrap(
+                        kernels.sgns_corpus_macro_step(
+                            self.negative, W, B, nb), "sgns_corpus_macro")
                     self._jax_key, k = jax.random.split(self._jax_key)
                     self.syn0, self.syn1, losses = step(
                         self.syn0, self.syn1, corpus_dev, sid_dev,
-                        self._neg_table_dev, keep, k, np.float32(lr))
-                    epoch_losses.append(losses)
+                        self._neg_table_dev, keep, k, np.float32(lr),
+                        np.int32(T), np.int32(nvb))
+                    # quota-masked trailing batches carry no pairs: keep
+                    # them out of the loss history
+                    epoch_losses.append(losses[:nvb])
                     self.words_processed += T
                     if built is not None:
-                        built.append((corpus_dev, sid_dev, T, nb))
+                        built.append((corpus_dev, sid_dev, T, nb, nvb))
                 if built is not None:
                     plan = built
             if epoch_losses:

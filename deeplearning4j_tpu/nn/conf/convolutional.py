@@ -22,6 +22,7 @@ manual pads.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional, Tuple
 
 import jax
@@ -61,6 +62,31 @@ def _padding_cfg(mode: str, padding):
         return "SAME"
     ph, pw = _pair(padding)
     return ((ph, ph), (pw, pw))
+
+
+def _s2d_eligible(x, kernel_size, stride, dilation, mode):
+    """See ConvolutionLayer._space_to_depth_eligible."""
+    return (mode == "same"
+            and _pair(kernel_size) == (7, 7)
+            and _pair(stride) == (2, 2)
+            and _pair(dilation) == (1, 1)
+            and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0
+            and x.shape[3] <= 4)
+
+
+def conv2d_forward(x, w, kernel_size, stride, padding, mode, dilation=(1, 1)):
+    """The one 2-D convolution lowering, shared by ConvolutionLayer and the
+    fused conv→BN→act block so both take the identical compute path
+    (including the ImageNet-stem space-to-depth rewrite)."""
+    if _s2d_eligible(x, kernel_size, stride, dilation, mode):
+        return ConvolutionLayer._space_to_depth_conv(x, w)
+    return lax.conv_general_dilated(
+        x, w,
+        window_strides=_pair(stride),
+        padding=_padding_cfg(mode, padding),
+        rhs_dilation=_pair(dilation),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
 
 
 @register_layer
@@ -142,16 +168,8 @@ class ConvolutionLayer(BaseLayer):
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         x = dropout_input(x, self.dropout, train, rng)
-        if self._space_to_depth_eligible(x):
-            z = self._space_to_depth_conv(x, params["W"])
-        else:
-            z = lax.conv_general_dilated(
-                x, params["W"],
-                window_strides=_pair(self.stride),
-                padding=_padding_cfg(self.convolution_mode, self.padding),
-                rhs_dilation=_pair(self.dilation),
-                dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            )
+        z = conv2d_forward(x, params["W"], self.kernel_size, self.stride,
+                           self.padding, self.convolution_mode, self.dilation)
         if self.has_bias:
             z = z + params["b"]
         return get_activation(self.activation)(z), state
@@ -512,3 +530,185 @@ class ZeroPadding1DLayer(Layer):
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         l, r = self.padding
         return jnp.pad(x, ((0, 0), (l, r), (0, 0))), state
+
+
+# ---------------------------------------------------------------------------
+# Fused Conv→BN→Activation(→residual-add) block (perf/fusion.py rewriter
+# target). Motivation (tools/PROFILE_r5.md): train-mode BN costs ~4.7 full
+# activation-set HBM crossings beyond the conv floor — BN backward alone
+# re-reads saved activation-sized buffers. The fused block's custom VJP
+# saves ONLY the conv output z plus O(C) per-batch mean/inv-std and
+# recomputes x-hat (and the activation pre-image) in the backward, the
+# In-Place Activated BatchNorm recipe (Bulò et al., CVPR 2018) expressed
+# through jax.custom_vjp instead of a hand-written kernel.
+
+def _bn_train_stats(z):
+    """Per-channel (mean, var) with the same numerics as
+    BatchNormalization.apply: single-pass f32-accumulated for low-precision
+    compute, exact centered two-pass otherwise."""
+    axes = tuple(range(z.ndim - 1))
+    if z.dtype in (jnp.bfloat16, jnp.float16):
+        zf = z.astype(jnp.float32)
+        n = zf.size // zf.shape[-1]
+        mean = jnp.sum(zf, axis=axes) / n
+        var = jnp.maximum(jnp.sum(zf * zf, axis=axes) / n - mean * mean, 0.0)
+    else:
+        mean = jnp.mean(z, axis=axes)
+        var = jnp.var(z, axis=axes)
+    return mean, var
+
+
+def _bn_act_fwd_math(act_name, eps, z, gamma, beta, res):
+    mean, var = _bn_train_stats(z)
+    sdt = var.dtype
+    inv = lax.rsqrt(var + jnp.asarray(eps, sdt))
+    scale = gamma.astype(sdt) * inv
+    shift = beta.astype(sdt) - mean * scale
+    pre = z * scale.astype(z.dtype) + shift.astype(z.dtype)
+    if res is not None:
+        pre = pre + res
+    return get_activation(act_name)(pre), mean, var, inv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def fused_bn_act_train(act_name, eps, z, gamma, beta, res):
+    """Train-mode BN + activation (+ optional residual add) over the conv
+    output ``z``, with a memory-efficient VJP: the backward recomputes the
+    normalized x-hat from ``z`` plus the saved O(C) (mean, inv-std) instead
+    of keeping activation-sized normalize/pre-activation buffers alive.
+
+    Returns ``(out, mean, var)``; the (mean, var) outputs exist ONLY to feed
+    the running-stat EMA and are not differentiated (their cotangents are
+    ignored — the running buffers are non-trainable state)."""
+    out, mean, var, _ = _bn_act_fwd_math(act_name, eps, z, gamma, beta, res)
+    return out, mean, var
+
+
+def _fused_bn_act_fwd(act_name, eps, z, gamma, beta, res):
+    out, mean, var, inv = _bn_act_fwd_math(act_name, eps, z, gamma, beta, res)
+    # residuals: z (which the conv dW backward saves anyway) + O(C) vectors
+    # (+ the residual-add input, itself another block's saved output)
+    return (out, mean, var), (z, gamma, beta, res, mean, inv)
+
+
+def _fused_bn_act_bwd(act_name, eps, saved, cts):
+    z, gamma, beta, res, mean, inv = saved
+    dout = cts[0]  # mean/var cotangents ignored (EMA-only outputs)
+    sdt = mean.dtype
+    scale = gamma.astype(sdt) * inv
+    shift = beta.astype(sdt) - mean * scale
+    pre = z * scale.astype(z.dtype) + shift.astype(z.dtype)
+    if res is not None:
+        pre = pre + res
+    # activation backward through the SAME activation implementation the
+    # forward used (recomputed pre-image, no saved buffer)
+    _, act_vjp = jax.vjp(get_activation(act_name), pre)
+    dpre = act_vjp(dout)[0]
+    axes = tuple(range(z.ndim - 1))
+    n = z.size // z.shape[-1]
+    zf = z.astype(sdt)
+    xhat = (zf - mean) * inv
+    dpre32 = dpre.astype(sdt)
+    dgamma = jnp.sum(dpre32 * xhat, axis=axes)
+    dbeta = jnp.sum(dpre32, axis=axes)
+    # full train-mode BN backward (gradients flow through the batch stats):
+    # dz = gamma*inv * (dpre - mean(dpre) - xhat * mean(dpre * xhat))
+    dz = (scale * (dpre32 - dbeta / n - xhat * (dgamma / n))).astype(z.dtype)
+    dres = None if res is None else dpre.astype(res.dtype)
+    return (dz, dgamma.astype(gamma.dtype), dbeta.astype(beta.dtype), dres)
+
+
+fused_bn_act_train.defvjp(_fused_bn_act_fwd, _fused_bn_act_bwd)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class FusedConvBNActivation(BaseLayer):
+    """Conv → train-mode BatchNorm → activation (optionally + residual add
+    before the activation) as ONE layer whose BN backward recomputes x-hat
+    instead of re-reading activation-sized saves (see fused_bn_act_train).
+
+    Produced by ``perf.fusion.fuse`` from matched ConvolutionLayer →
+    BatchNormalization → ActivationLayer(→ ElementWiseVertex add) patterns;
+    usable directly as well. ``residual=True`` (ComputationGraph only) adds
+    a second vertex input to the pre-activation. Math is identical to the
+    unfused stack within fp tolerance; parameter layout is the union of the
+    conv's (W[, b]) and the BN's (gamma, beta) with the BN running stats in
+    the layer state."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    kernel_size: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    convolution_mode: str = "truncate"
+    dilation: Tuple[int, int] = (1, 1)
+    has_bias: bool = False
+    activation: str = "relu"
+    # BatchNormalization fields (gamma/beta are the INIT values)
+    decay: float = 0.9
+    eps: float = 1e-5
+    gamma: float = 1.0
+    beta: float = 0.0
+    residual: bool = False
+
+    def input_kind(self):
+        return "cnn"
+
+    def output_type(self, it: InputType) -> InputType:
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        dh, dw = _pair(self.dilation)
+        h = _conv_out(it.height, kh, sh, ph, self.convolution_mode, dh)
+        w = _conv_out(it.width, kw, sw, pw, self.convolution_mode, dw)
+        return InputType.convolutional(h, w, self.n_out)
+
+    def with_n_in(self, n_in):
+        return self  # n_in is channels, set from the input type in init
+
+    def init(self, rng, it: InputType, dtype=jnp.float32):
+        kh, kw = _pair(self.kernel_size)
+        c_in = self.n_in or it.channels
+        fan_in = c_in * kh * kw
+        fan_out = self.n_out * kh * kw
+        params = {"W": init_weights(rng, (kh, kw, c_in, self.n_out), fan_in,
+                                    fan_out, self.weight_init, self.dist,
+                                    dtype)}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        params["gamma"] = jnp.full((self.n_out,), self.gamma, dtype)
+        params["beta"] = jnp.full((self.n_out,), self.beta, dtype)
+        state = {"mean": jnp.zeros((self.n_out,), dtype),
+                 "var": jnp.ones((self.n_out,), dtype)}
+        return params, state
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None,
+              res=None):
+        from deeplearning4j_tpu.perf.compile_watch import bump_active
+        bump_active("fusion.fused_block")
+        x = dropout_input(x, self.dropout, train, rng)
+        z = conv2d_forward(x, params["W"], self.kernel_size, self.stride,
+                           self.padding, self.convolution_mode, self.dilation)
+        if self.has_bias:
+            z = z + params["b"]
+        gamma, beta = params["gamma"], params["beta"]
+        if train:
+            out, mean, var = fused_bn_act_train(self.activation, self.eps,
+                                                z, gamma, beta, res)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1.0 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1.0 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            sdt = var.dtype
+            inv = lax.rsqrt(var + jnp.asarray(self.eps, sdt))
+            scale = gamma.astype(sdt) * inv
+            shift = beta.astype(sdt) - mean * scale
+            pre = z * scale.astype(z.dtype) + shift.astype(z.dtype)
+            if res is not None:
+                pre = pre + res
+            out = get_activation(self.activation)(pre)
+            new_state = state
+        return out, new_state

@@ -412,9 +412,18 @@ class ComputationGraphConfiguration:
 
     def memory_report(self, minibatch: int = 32):
         """Analytic per-vertex parameter + activation memory (no device
-        allocation). See nn/memory.py::conf_memory_report."""
+        allocation), plus the measured training-activation-bytes line
+        (jaxpr-derived residual set of the real train step). See
+        nn/memory.py::conf_memory_report."""
         from deeplearning4j_tpu.nn.memory import conf_memory_report
         return conf_memory_report(self, minibatch=minibatch)
+
+    def fused(self) -> "ComputationGraphConfiguration":
+        """Conv→BN→Act(→residual-add) fusion rewrite of this DAG
+        (perf/fusion.py). Matched chains — including the residual
+        bottleneck pattern — become FusedConvBNActivation vertices."""
+        from deeplearning4j_tpu.perf.fusion import fuse
+        return fuse(self)
 
     # ---- serde ----
     def to_json(self) -> str:

@@ -184,6 +184,26 @@ def apply_constraints(layer, params):
     return params
 
 
+def apply_layer(layer, params, state, x, *, train, rng, mask, extra=None):
+    """The networks' single entry into ``layer.apply``: lowers the layer
+    through ``jax.checkpoint`` when its ``remat=`` knob is set (policy names
+    in perf/fusion.py), so the backward pass recomputes instead of saving
+    what the policy excludes. ``extra`` carries optional additional traced
+    inputs (the fused residual-add input in ComputationGraph)."""
+    extra = extra or {}
+    if getattr(layer, "remat", None):
+        from deeplearning4j_tpu.perf.fusion import remat_policy
+        policy = remat_policy(layer.remat)
+
+        def run(p, s, xx, kk, mm, ee):
+            return layer.apply(p, s, xx, train=train, rng=kk, mask=mm, **ee)
+
+        return jax.checkpoint(run, policy=policy)(params, state, x, rng,
+                                                  mask, extra)
+    return layer.apply(params, state, x, train=train, rng=rng, mask=mask,
+                       **extra)
+
+
 def noisy_params(layer, params, rng, train: bool):
     """Apply the layer's weight noise for a training forward pass (reference
     BaseLayer.getParamWithNoise via IWeightNoise). Uses a stream folded off
@@ -214,6 +234,12 @@ class Layer:
 
     name: Optional[str] = None
     dropout: float = 0.0
+    # per-layer rematerialization: lower this layer's apply through
+    # jax.checkpoint with the named policy (perf/fusion.py REMAT_POLICIES:
+    # 'full' recomputes everything in the backward; 'dots_saveable' keeps
+    # matmul/conv outputs; ...). None = normal autodiff saving. Validated
+    # by analysis/validation.py; visible in conf.memory_report().
+    remat: Optional[str] = None
 
     # ---- shape inference ----
     def output_type(self, input_type: InputType) -> InputType:

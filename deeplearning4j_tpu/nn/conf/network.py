@@ -135,11 +135,21 @@ class MultiLayerConfiguration:
     def memory_report(self, input_type=None, minibatch: int = 32):
         """Analytic per-layer parameter + activation memory for this
         configuration (no device allocation: parameter shapes come from
-        ``jax.eval_shape`` of each layer's init). See
-        nn/memory.py::conf_memory_report."""
+        ``jax.eval_shape`` of each layer's init), plus the measured
+        training-activation-bytes line (jaxpr-derived residual set of the
+        real train step — compare against ``self.fused()`` for the fusion
+        win). See nn/memory.py::conf_memory_report."""
         from deeplearning4j_tpu.nn.memory import conf_memory_report
         return conf_memory_report(self, input_type=input_type,
                                   minibatch=minibatch)
+
+    def fused(self) -> "MultiLayerConfiguration":
+        """Conv→BN→Act fusion rewrite of this configuration
+        (perf/fusion.py): matched chains become FusedConvBNActivation
+        blocks whose BN backward recomputes instead of re-reading saved
+        activations. Opt out by simply not calling this."""
+        from deeplearning4j_tpu.perf.fusion import fuse
+        return fuse(self)
 
     # ---- serde (reference toJson/fromJson) ----
     def to_json(self) -> str:

@@ -25,7 +25,8 @@ from deeplearning4j_tpu.nn.conf.graph import (
     ComputationGraphConfiguration, DuplicateToTimeSeriesVertex, LastTimeStepVertex,
 )
 from deeplearning4j_tpu.nn.conf.layers import (Layer, apply_constraints,
-                                               dropout_input, noisy_params)
+                                               apply_layer, dropout_input,
+                                               noisy_params)
 from deeplearning4j_tpu.optimize.fused_update import bucketed_apply
 from deeplearning4j_tpu.optimize.updaters import gradient_normalization
 from deeplearning4j_tpu.perf.compile_watch import CompileWatch
@@ -177,8 +178,16 @@ class ComputationGraph:
                     new_carries[name] = nc
                     new_state[name] = state[name]
                 else:
-                    out, st = obj.apply(p_v, state[name], xs[0],
-                                        train=train, rng=k, mask=in_mask)
+                    # fused conv→BN→act blocks with residual=True take the
+                    # residual-add operand as a second vertex input
+                    extra = ({"res": xs[1]}
+                             if getattr(obj, "residual", False) and len(xs) > 1
+                             else None)
+                    # apply_layer lowers through jax.checkpoint when the
+                    # layer's remat= knob is set (perf/fusion.py policies)
+                    out, st = apply_layer(obj, p_v, state[name], xs[0],
+                                          train=train, rng=k, mask=in_mask,
+                                          extra=extra)
                     new_state[name] = st
                 out_kind = obj.output_type(self.vertex_input_types[name][0]).kind
                 mask_of[name] = in_mask if out_kind in ("rnn", "cnn1d") else None
@@ -206,13 +215,13 @@ class ComputationGraph:
 
     def _regularization(self, params):
         from deeplearning4j_tpu.nn.conf.layers import (
-            regularization_coefficients, resolve_param_path,
+            _bias_keys, regularization_coefficients, resolve_param_path,
         )
         total = 0.0
         for name in self._layer_names:
             layer = self.vertices[name][0]
             p = params[name]
-            l1, l2, _, _ = regularization_coefficients(layer)
+            l1, l2, l1b, l2b = regularization_coefficients(layer)
             for key in layer.regularizable():
                 w = resolve_param_path(p, key)
                 if w is not None:
@@ -222,6 +231,18 @@ class ComputationGraph:
                         total = total + 0.5 * l2 * jnp.sum(w * w)
                     if l1:
                         total = total + l1 * jnp.sum(jnp.abs(w))
+            if l1b or l2b:
+                # bias terms were silently skipped here (MLN parity):
+                # _bias_keys covers both top-level 'b' and nested wrapper/
+                # attention biases (q/b, k/b, ...)
+                for bk in _bias_keys(layer, p):
+                    b = resolve_param_path(p, bk)
+                    if b.dtype in (jnp.bfloat16, jnp.float16):
+                        b = b.astype(jnp.float32)
+                    if l2b:
+                        total = total + 0.5 * l2b * jnp.sum(b * b)
+                    if l1b:
+                        total = total + l1b * jnp.sum(jnp.abs(b))
         return total
 
     # ------------------------------------------------------------ train step

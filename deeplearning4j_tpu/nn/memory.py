@@ -37,6 +37,8 @@ class LayerMemoryReport:
     # activation size for ONE example (bytes); multiply by minibatch
     activation_bytes_per_example: int
     activation_shape: tuple
+    # the layer's remat= knob, when set (perf/fusion.py policies)
+    remat: Optional[str] = None
 
     def to_dict(self):
         return dataclasses.asdict(self)
@@ -56,6 +58,14 @@ class MemoryReport:
     # measured from the compiled train step's buffer assignment (None when
     # compilation was skipped)
     compiled: Optional[dict] = None
+    # bytes the train-mode loss forward actually saves for its backward
+    # (jaxpr-derived via perf/fusion.training_activation_bytes; None when
+    # the conf has no loss layer or the trace is unsupported). Fusion and
+    # per-layer remat= knobs move THIS number — the per-layer analytic
+    # column above is layout-only and cannot see them.
+    training_activation_bytes: Optional[int] = None
+    # FusedConvBNActivation blocks in the configuration
+    fused_blocks: int = 0
 
     def total_fixed_bytes(self) -> int:
         return self.total_param_bytes + self.updater_state_bytes
@@ -78,12 +88,20 @@ class MemoryReport:
             lines.append(
                 f"{lr.name:<28}{lr.layer_class:<26}{lr.num_params:>12,}"
                 f"{lr.param_bytes / 2**20:>10.2f}"
-                f"{lr.activation_bytes_per_example / 2**10:>11.1f}")
+                f"{lr.activation_bytes_per_example / 2**10:>11.1f}"
+                + (f"  remat={lr.remat}" if lr.remat else ""))
         lines.append(
             f"Totals: params {self.total_param_bytes / 2**20:.2f} MB, "
             f"updater state {self.updater_state_bytes / 2**20:.2f} MB, "
             f"activations {self.total_activation_bytes / 2**20:.2f} MB "
             f"@ minibatch {self.minibatch}")
+        if self.training_activation_bytes is not None:
+            lines.append(
+                "Training residuals (fwd->bwd saved tensors, jaxpr-derived): "
+                f"{self.training_activation_bytes / 2**20:.2f} MB @ "
+                f"minibatch {self.minibatch}"
+                + (f" ({self.fused_blocks} fused conv+BN blocks)"
+                   if self.fused_blocks else ""))
         if self.compiled:
             c = self.compiled
             lines.append(
@@ -141,11 +159,18 @@ def get_memory_report(net, minibatch: int = 32,
             num_params=int(n_params),
             param_bytes=int(p_bytes),
             activation_bytes_per_example=int(act_bytes),
-            activation_shape=act_shape))
+            activation_shape=act_shape,
+            remat=getattr(layer, "remat", None)))
         total_act += act_bytes * minibatch
     compiled = None
     if compile_step:
         compiled = _compiled_step_stats(net, minibatch, types[0])
+    try:
+        from deeplearning4j_tpu.perf.fusion import training_activation_bytes
+        train_bytes = int(training_activation_bytes(conf,
+                                                    minibatch=minibatch))
+    except Exception:
+        train_bytes = None
     return MemoryReport(
         model_class=type(net).__name__,
         minibatch=minibatch,
@@ -154,7 +179,11 @@ def get_memory_report(net, minibatch: int = 32,
         total_param_bytes=int(_tree_bytes(net.params)),
         total_activation_bytes=int(total_act),
         updater_state_bytes=int(_tree_bytes(net.opt_state)),
-        compiled=compiled)
+        compiled=compiled,
+        training_activation_bytes=train_bytes,
+        fused_blocks=sum(
+            1 for l in net.layers
+            if type(l).__name__ == "FusedConvBNActivation"))
 
 
 def _abstract_layer_stats(layer, it, key, itemsize: int):
@@ -207,6 +236,7 @@ def conf_memory_report(conf, input_type=None, minibatch: int = 32) -> MemoryRepo
                 per_layer_updater.append(
                     getattr(obj, "updater", None) or conf.updater)
 
+    fused_blocks = 0
     for (name, layer, it), upd in zip(entries, per_layer_updater):
         n_params, p_bytes, p_abs = _abstract_layer_stats(layer, it, key,
                                                          itemsize)
@@ -219,7 +249,10 @@ def conf_memory_report(conf, input_type=None, minibatch: int = 32) -> MemoryRepo
             name=name, layer_class=type(layer).__name__,
             num_params=n_params, param_bytes=p_bytes,
             activation_bytes_per_example=int(act_bytes),
-            activation_shape=act_shape))
+            activation_shape=act_shape,
+            remat=getattr(layer, "remat", None)))
+        if type(layer).__name__ == "FusedConvBNActivation":
+            fused_blocks += 1
         total_act += act_bytes * minibatch
         total_params += p_bytes
         if n_params:
@@ -229,6 +262,15 @@ def conf_memory_report(conf, input_type=None, minibatch: int = 32) -> MemoryRepo
                 for a in jax.tree_util.tree_leaves(opt)
                 if hasattr(a, "shape")))
 
+    # the measured fwd->bwd residual set (fusion/remat-aware); best-effort:
+    # inference-only confs (no loss layer) and exotic label shapes skip it
+    try:
+        from deeplearning4j_tpu.perf.fusion import training_activation_bytes
+        train_bytes = int(training_activation_bytes(conf,
+                                                    minibatch=minibatch))
+    except Exception:
+        train_bytes = None
+
     return MemoryReport(
         model_class=type(conf).__name__,
         minibatch=minibatch,
@@ -237,7 +279,9 @@ def conf_memory_report(conf, input_type=None, minibatch: int = 32) -> MemoryRepo
         total_param_bytes=int(total_params),
         total_activation_bytes=int(total_act),
         updater_state_bytes=int(updater_bytes),
-        compiled=None)
+        compiled=None,
+        training_activation_bytes=train_bytes,
+        fused_blocks=fused_blocks)
 
 
 def _compiled_step_stats(net, minibatch: int, first_input_type) -> Optional[dict]:

@@ -24,7 +24,7 @@ import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.nn.activations import get_activation
-from deeplearning4j_tpu.nn.conf.layers import (apply_constraints,
+from deeplearning4j_tpu.nn.conf.layers import (apply_constraints, apply_layer,
                                                dropout_input, noisy_params)
 from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
 from deeplearning4j_tpu.optimize.fused_update import bucketed_apply
@@ -183,7 +183,10 @@ class MultiLayerNetwork:
                 new_state.append(state[i])
                 new_carries.append(nc)
             else:
-                x, st = layer.apply(p_i, state[i], x, train=train, rng=k, mask=cur_mask)
+                # apply_layer lowers through jax.checkpoint when the layer's
+                # remat= knob is set (perf/fusion.py policies)
+                x, st = apply_layer(layer, p_i, state[i], x, train=train,
+                                    rng=k, mask=cur_mask)
                 new_state.append(st)
                 new_carries.append({})
             if not self._mask_survives[i]:
@@ -195,7 +198,7 @@ class MultiLayerNetwork:
         """L1/L2 penalty (reference BaseLayer.calcL2/calcL1; score term added in
         BaseOutputLayer.computeScore fullNetworkL1/L2)."""
         from deeplearning4j_tpu.nn.conf.layers import (
-            regularization_coefficients, resolve_param_path,
+            _bias_keys, regularization_coefficients, resolve_param_path,
         )
         total = 0.0
         for layer, p in zip(self.layers, params):
@@ -209,14 +212,17 @@ class MultiLayerNetwork:
                         total = total + 0.5 * l2 * jnp.sum(w * w)
                     if l1:
                         total = total + l1 * jnp.sum(jnp.abs(w))
-            if (l1b or l2b) and "b" in p:
-                b = p["b"]
-                if b.dtype in (jnp.bfloat16, jnp.float16):
-                    b = b.astype(jnp.float32)
-                if l2b:
-                    total = total + 0.5 * l2b * jnp.sum(b * b)
-                if l1b:
-                    total = total + l1b * jnp.sum(jnp.abs(b))
+            if l1b or l2b:
+                # _bias_keys, not just "b": nested attention biases (q/b,
+                # k/b, ...) are penalized as attention.py's docstring claims
+                for bk in _bias_keys(layer, p):
+                    b = resolve_param_path(p, bk)
+                    if b.dtype in (jnp.bfloat16, jnp.float16):
+                        b = b.astype(jnp.float32)
+                    if l2b:
+                        total = total + 0.5 * l2b * jnp.sum(b * b)
+                    if l1b:
+                        total = total + l1b * jnp.sum(jnp.abs(b))
         return total
 
     # ------------------------------------------------------------ train step

@@ -73,16 +73,25 @@ class ParallelInference:
     bucket_policy: perf.BucketPolicy controlling the canonical dispatch
     sizes (default: power-of-two buckets with floor 8). Pass ``None`` to
     disable bucketing — every distinct padded batch size then compiles its
-    own program, which is almost never what you want in serving."""
+    own program, which is almost never what you want in serving.
+
+    fold_bn: serve a BN-folded COPY of the model (perf/fusion.fold_bn) —
+    every Conv→BatchNorm pair collapses into the conv's weights/bias, so
+    serving dispatches pay no per-request normalize traffic at all. The
+    caller's model object is untouched; exact within fp tolerance
+    (analysis/lint.py DLT005 flags serving sites that skip this)."""
 
     _DEFAULT_POLICY = object()
 
     def __init__(self, model, mesh=None, batch_limit: int = 32,
                  queue_timeout_ms: int = 5, inference_mode: str = "batched",
                  bucket_policy=_DEFAULT_POLICY,
-                 batch_size_history: int = 1024):
+                 batch_size_history: int = 1024, fold_bn: bool = False):
         if inference_mode not in ("batched", "sequential"):
             raise ValueError(f"unknown inference_mode '{inference_mode}'")
+        if fold_bn:
+            from deeplearning4j_tpu.perf.fusion import fold_bn as _fold_bn
+            model = _fold_bn(model)
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.batch_limit = batch_limit
@@ -265,6 +274,13 @@ class ParallelInference:
             att = cw.counters("attention.")
             if att:
                 out["attention"] = att
+            # fused conv+BN block trace hits (nn/conf/convolutional.py
+            # FusedConvBNActivation.apply): a serving model expected to run
+            # fused (or folded — folded graphs count ZERO here) is
+            # verifiable from stats rather than from step latency
+            fus = cw.counters("fusion.")
+            if fus:
+                out["fusion"] = fus
         # last analysis.trace_check report for this model, if one ran
         report = getattr(self.model, "last_trace_report", None)
         if report is not None:

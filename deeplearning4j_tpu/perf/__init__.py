@@ -8,7 +8,11 @@ Three cooperating pieces (see each module's docstring):
 - ``prefetch``      — DevicePrefetchIterator: double-buffered, sharding-aware
                       device placement of batch N+1 while step N runs;
 - ``compile_watch`` — CompileWatch: compile/dispatch counters so tests and
-                      benches can assert "N batches, 1 compile".
+                      benches can assert "N batches, 1 compile";
+- ``fusion``        — fuse/fuse_network (Conv→BN→Act fused blocks with a
+                      memory-efficient custom VJP), fold_bn (inference-time
+                      BN folding), remat policies, and the jaxpr-derived
+                      training_activation_bytes measurement.
 """
 
 from deeplearning4j_tpu.perf.bucketing import (  # noqa: F401
@@ -23,5 +27,13 @@ from deeplearning4j_tpu.perf.compile_watch import (  # noqa: F401
     GLOBAL as GLOBAL_COMPILE_WATCH,
     CompileWatch,
     backend_compile_events,
+)
+from deeplearning4j_tpu.perf.fusion import (  # noqa: F401
+    REMAT_POLICIES,
+    fold_bn,
+    fuse,
+    fuse_network,
+    remat_policy,
+    training_activation_bytes,
 )
 from deeplearning4j_tpu.perf.prefetch import DevicePrefetchIterator  # noqa: F401

@@ -1,0 +1,55 @@
+"""Shared conf/data helpers for the 2-process multi-host tests.
+
+Imported by BOTH tests/test_multihost.py (in the pytest process) and
+tests/multihost_worker.py (in each worker subprocess). Deliberately
+side-effect-free: no jax import, no env mutation, no platform forcing at
+module scope — the worker's ``jax_platforms="cpu"`` override and
+``--xla_force_host_platform_device_count`` flag live in the worker script
+only, so importing these helpers can never leak either into the rest of
+the pytest session.
+"""
+
+import numpy as np
+
+
+def _conf(seed=17, updater=None):
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    return (NeuralNetConfiguration.builder()
+            .seed(seed).updater(updater or Sgd(learning_rate=0.05))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+
+
+def _graph_conf():
+    from deeplearning4j_tpu.nn.conf import InputType
+    from deeplearning4j_tpu.nn.conf.graph import GraphBuilder
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.network import Builder as NNBuilder
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    parent = NNBuilder()
+    parent.seed(23).updater(Adam(learning_rate=0.02)).weight_init("xavier")
+    return (GraphBuilder(parent)
+            .add_inputs("in")
+            .add_layer("h", DenseLayer(n_out=16, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_out=3, loss="mcxent"), "h")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+
+
+def _iris_global():
+    from deeplearning4j_tpu.datasets import IrisDataSetIterator
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    full = next(iter(IrisDataSetIterator(batch=150)))
+    return DataSet(full.features[:144], full.labels[:144])
+
+
+def _flat_params(params):
+    import jax as _j
+    flat, _ = _j.tree_util.tree_flatten_with_path(params)
+    return {_j.tree_util.keystr(path): np.asarray(v) for path, v in flat}

@@ -30,48 +30,11 @@ jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 
-
-def _conf(seed=17, updater=None):
-    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
-    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
-    from deeplearning4j_tpu.optimize.updaters import Sgd
-    return (NeuralNetConfiguration.builder()
-            .seed(seed).updater(updater or Sgd(learning_rate=0.05))
-            .weight_init("xavier").list()
-            .layer(DenseLayer(n_out=16, activation="tanh"))
-            .layer(OutputLayer(n_out=3, loss="mcxent"))
-            .set_input_type(InputType.feed_forward(4))
-            .build())
-
-
-def _graph_conf():
-    from deeplearning4j_tpu.nn.conf import InputType
-    from deeplearning4j_tpu.nn.conf.graph import GraphBuilder
-    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
-    from deeplearning4j_tpu.nn.conf.network import Builder as NNBuilder
-    from deeplearning4j_tpu.optimize.updaters import Adam
-    parent = NNBuilder()
-    parent.seed(23).updater(Adam(learning_rate=0.02)).weight_init("xavier")
-    return (GraphBuilder(parent)
-            .add_inputs("in")
-            .add_layer("h", DenseLayer(n_out=16, activation="tanh"), "in")
-            .add_layer("out", OutputLayer(n_out=3, loss="mcxent"), "h")
-            .set_outputs("out")
-            .set_input_types(InputType.feed_forward(4))
-            .build())
-
-
-def _iris_global():
-    from deeplearning4j_tpu.datasets import IrisDataSetIterator
-    from deeplearning4j_tpu.datasets.dataset import DataSet
-    full = next(iter(IrisDataSetIterator(batch=150)))
-    return DataSet(full.features[:144], full.labels[:144])
-
-
-def _flat_params(params):
-    import jax as _j
-    flat, _ = _j.tree_util.tree_flatten_with_path(params)
-    return {_j.tree_util.keystr(path): np.asarray(v) for path, v in flat}
+# shared, side-effect-free conf/data helpers (same module the parent test
+# imports — the env/platform mutations above stay in THIS script)
+from multihost_common import (  # noqa: E402,F401
+    _conf, _flat_params, _graph_conf, _iris_global,
+)
 
 
 def main():

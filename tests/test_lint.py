@@ -215,6 +215,57 @@ class TestLockOrder:
         """) == []
 
 
+class TestServingBnFold:
+    _SERVING_WITH_BN = """
+        from deeplearning4j_tpu.nn.conf.normalization import BatchNormalization
+        from deeplearning4j_tpu.parallel import ParallelInference
+
+        def serve(builder, net_cls):
+            conf = builder.layer(BatchNormalization()).build()
+            net = net_cls(conf).init()
+            pi = ParallelInference(net, batch_limit=8)
+            return pi
+    """
+
+    def test_fires_on_bn_model_served_unfolded(self):
+        vs = _lint(self._SERVING_WITH_BN)
+        assert _rules(vs) == ["DLT005"]
+        assert "fold_bn" in vs[0].message
+
+    def test_fold_bn_call_clean(self):
+        src = self._SERVING_WITH_BN.replace(
+            "pi = ParallelInference(net, batch_limit=8)",
+            "pi = ParallelInference(fold_bn(net), batch_limit=8)")
+        assert _lint(src) == []
+
+    def test_fold_bn_kwarg_clean(self):
+        src = self._SERVING_WITH_BN.replace(
+            "ParallelInference(net, batch_limit=8)",
+            "ParallelInference(net, batch_limit=8, fold_bn=True)")
+        assert _lint(src) == []
+
+    def test_explicit_fold_bn_false_still_fires(self):
+        src = self._SERVING_WITH_BN.replace(
+            "ParallelInference(net, batch_limit=8)",
+            "ParallelInference(net, batch_limit=8, fold_bn=False)")
+        assert _rules(_lint(src)) == ["DLT005"]
+
+    def test_no_bn_clean(self):
+        assert _lint("""
+            from deeplearning4j_tpu.parallel import ParallelInference
+
+            def serve(net):
+                return ParallelInference(net)
+        """) == []
+
+    def test_inline_waiver(self):
+        src = self._SERVING_WITH_BN.replace(
+            "pi = ParallelInference(net, batch_limit=8)",
+            "pi = ParallelInference(net, batch_limit=8)  "
+            "# lint: disable=DLT005 (train-mode serving by design)")
+        assert _lint(src) == []
+
+
 class TestFileWaiver:
     def test_disable_file(self):
         vs = _lint("""

@@ -21,6 +21,7 @@ import sys
 import numpy as np
 import pytest
 
+import multihost_common as mhc
 from deeplearning4j_tpu.datasets import IrisDataSetIterator
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.parallel.sharding import (
@@ -66,31 +67,18 @@ def _run_workers(mode, tmp_path, timeout=420, require_ranks=(0, 1)):
 
 
 def _single_process_params(conf_fn, is_graph, epochs=5):
-    """Single-process training on the same seed/global batch. The worker
-    module appends device_count=4 to XLA_FLAGS on import (for its OWN
-    subprocess use) — restore the env and drop the module so no later test
-    or subprocess inherits the mutation."""
-    import importlib.util
-    saved_flags = os.environ.get("XLA_FLAGS")
-    spec = importlib.util.spec_from_file_location("mh_worker", _WORKER)
-    w = importlib.util.module_from_spec(spec)
-    sys.modules["mh_worker"] = w
-    try:
-        spec.loader.exec_module(w)
-    finally:
-        if saved_flags is None:
-            os.environ.pop("XLA_FLAGS", None)
-        else:
-            os.environ["XLA_FLAGS"] = saved_flags
-        sys.modules.pop("mh_worker", None)
+    """Single-process training on the same seed/global batch, through the
+    side-effect-free shared helpers module (multihost_common) — the worker
+    script's XLA_FLAGS / jax_platforms mutations never load into the
+    pytest process."""
     from deeplearning4j_tpu.nn.graph import ComputationGraph
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-    conf = getattr(w, conf_fn)()
+    conf = getattr(mhc, conf_fn)()
     net = (ComputationGraph(conf) if is_graph
            else MultiLayerNetwork(conf)).init()
-    ds = w._iris_global()
+    ds = mhc._iris_global()
     net.fit(ds, num_epochs=epochs)
-    return w._flat_params(net.params)
+    return mhc._flat_params(net.params)
 
 
 def test_two_process_mln_sgd_matches_single_process(tmp_path, devices):
@@ -135,6 +123,21 @@ def test_watchdog_fires_on_dead_peer(tmp_path, devices):
     msg = (tmp_path / "wd-fired.txt").read_text()
     assert "did not complete within" in msg
     assert "process 0/2" in msg
+
+
+def test_shared_helpers_do_not_leak_platform_overrides():
+    """Regression (ADVICE r5): the conf/data helpers both processes share
+    must be importable without the worker's jax_platforms="cpu" /
+    XLA_FLAGS device-count mutations leaking into the pytest session."""
+    import importlib
+    saved = os.environ.get("XLA_FLAGS")
+    importlib.reload(mhc)  # side-effect-free: reload mutates nothing
+    assert os.environ.get("XLA_FLAGS") == saved
+    src = open(mhc.__file__).read()
+    for token in ("os.environ", "config.update("):
+        assert token not in src, f"helper module must not touch {token}"
+    # the worker script (which DOES mutate both) stays subprocess-only
+    assert "multihost_worker" not in sys.modules
 
 
 # ---------------------------------------------------------- shard helpers

@@ -176,3 +176,59 @@ def test_regularization_serde_roundtrip():
     assert l0.dropout == GaussianDropout(0.25)
     # rebuilt net still trains
     MultiLayerNetwork(back).init().fit(toy())
+
+
+# --------------------------------------------- bias regularization routing
+def test_attention_bias_regularization_penalized():
+    """ADVICE r5: l1_bias/l2_bias must reach NESTED bias params (q/b, k/b,
+    v/b, o/b) through _bias_keys, as attention.py's docstring claims."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.conf.attention import SelfAttentionLayer
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater(Sgd(learning_rate=0.1)).list()
+            .layer(SelfAttentionLayer(n_out=8, n_heads=2, l2_bias=0.5))
+            .layer(OutputLayer(n_out=2, loss="mcxent"))
+            .set_input_type(InputType.recurrent(4, 5))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    # biases init to zero: set them nonzero so the penalty is visible
+    for grp in ("q", "k", "v", "o"):
+        net.params[0][grp]["b"] = jnp.ones_like(net.params[0][grp]["b"])
+    penalty = float(net._regularization(net.params))
+    # 0.5 * l2_bias * sum(b^2) = 0.5 * 0.5 * (4 groups * 8 ones) = 8.0
+    assert penalty == pytest.approx(8.0)
+
+
+def test_graph_bias_regularization_not_skipped():
+    """ADVICE r5: ComputationGraph._regularization silently skipped every
+    bias term; it must now match the MLN path."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.conf.graph import GraphBuilder
+    from deeplearning4j_tpu.nn.conf.network import Builder as NNBuilder
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    parent = NNBuilder()
+    parent.seed(7).updater(Sgd(learning_rate=0.1))
+    conf = (GraphBuilder(parent)
+            .add_inputs("in")
+            .add_layer("h", DenseLayer(n_out=4, activation="tanh",
+                                       l2_bias=0.2, l1_bias=0.1), "in")
+            .add_layer("out", OutputLayer(n_out=2, loss="mcxent"), "h")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(3))
+            .build())
+    net = ComputationGraph(conf).init()
+    net.params["h"]["b"] = 2.0 * jnp.ones_like(net.params["h"]["b"])
+    penalty = float(net._regularization(net.params))
+    # 0.5*0.2*sum(2^2)*4 + 0.1*sum(|2|)*4 = 1.6 + 0.8
+    assert penalty == pytest.approx(2.4)
+    # and the MLN path agrees on the same layer config
+    mconf = (NeuralNetConfiguration.builder()
+             .seed(7).updater(Sgd(learning_rate=0.1)).list()
+             .layer(DenseLayer(n_out=4, activation="tanh",
+                               l2_bias=0.2, l1_bias=0.1))
+             .layer(OutputLayer(n_out=2, loss="mcxent"))
+             .set_input_type(InputType.feed_forward(3))
+             .build())
+    mnet = MultiLayerNetwork(mconf).init()
+    mnet.params[0]["b"] = 2.0 * np.ones_like(mnet.params[0]["b"])
+    assert float(mnet._regularization(mnet.params)) == pytest.approx(2.4)
