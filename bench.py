@@ -54,6 +54,7 @@ NOMINAL = {
     "word2vec": 500_000.0,  # words/sec, multithreaded host SGNS
     "serving": 10_000.0,    # req/sec, nominal GPU dynamic-batching server
     "checkpoint": 1_000.0,  # steps/sec, nominal small-model step loop
+    "resilience": 100.0,    # ms, nominal small-model restore/swap budget
 }
 
 
@@ -566,10 +567,139 @@ def bench_checkpoint():
               "acceptance: overhead_async_pct < 10. " + _REPS_NOTE)
 
 
+def bench_resilience():
+    """Fault-tolerance path costs, metrics only (no thresholds here: the
+    9p filesystem's fsync jitter swings disk-backed numbers run to run —
+    acceptance bars belong to quiet full runs, per the checkpoint bench's
+    note): (1) restore_latest latency through the LocalFS vs the
+    ObjectStore backend — the time a preempted worker spends between
+    process-up and training-again; (2) serving hot-swap pause — the max
+    inter-dispatch gap a ParallelInference client sees while a checkpoint
+    swap lands, vs its median gap without one (the swap prepares params
+    off the dispatch path and only the pointer swap holds the model lock,
+    so the gap should stay near the ordinary dispatch cadence)."""
+    import shutil
+    import tempfile
+
+    from deeplearning4j_tpu.checkpoint import (CheckpointManager,
+                                               ObjectStoreBackend)
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+    n_features, n_classes, hidden = 64, 10, 128
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((256, n_features)).astype(np.float32)
+    y = np.eye(n_classes, dtype=np.float32)[
+        rng.integers(0, n_classes, 256)]
+    ds = DataSet(x, y)
+
+    def make_net():
+        conf = (NeuralNetConfiguration.builder()
+                .seed(23).updater(Sgd(learning_rate=0.01))
+                .weight_init("xavier").list()
+                .layer(DenseLayer(n_out=hidden, activation="relu"))
+                .layer(OutputLayer(n_out=n_classes, loss="mcxent"))
+                .set_input_type(InputType.feed_forward(n_features))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def restore_ms(cm):
+        import jax
+
+        def timed():
+            t0 = time.perf_counter()
+            m = cm.restore_latest()
+            # materialize a param leaf before stopping the clock
+            np.asarray(jax.tree_util.tree_leaves(m.params)[0])
+            return time.perf_counter() - t0
+        return _best_of(timed) * 1000.0
+
+    # --- restore latency, local vs object store ---------------------------
+    tmp = tempfile.mkdtemp(prefix="bench_resil_")
+    try:
+        net = make_net()
+        net.fit(ds)
+        cm_local = CheckpointManager(os.path.join(tmp, "local"),
+                                     async_write=False)
+        cm_obj = CheckpointManager(storage=ObjectStoreBackend(),
+                                   async_write=False)
+        for cm in (cm_local, cm_obj):
+            for _ in range(3):
+                net.fit(ds)
+                cm.save(net)
+        local_ms = restore_ms(cm_local)
+        object_ms = restore_ms(cm_obj)
+        cm_local.close()
+
+        # --- serving hot-swap pause --------------------------------------
+        import threading
+
+        served = cm_obj.restore_latest(load_updater=False)
+        pi = ParallelInference(served, inference_mode="sequential")
+        pi.start_hot_swap(cm_obj)  # manual polls; no background thread
+        req = x[:8]
+        pi.warmup(req)
+        gaps_plain, gaps_swap = [], []
+
+        def drive(gaps, n, swap_at=None):
+            # the swap runs on its own thread, like the real poller — the
+            # client stream only feels the param-pointer swap's lock hold
+            swapper = None
+            last = time.perf_counter()
+            for i in range(n):
+                if i == swap_at:
+                    swapper = threading.Thread(target=pi.poll_checkpoint)
+                    swapper.start()
+                np.asarray(pi.output(req))
+                now = time.perf_counter()
+                gaps.append(now - last)
+                last = now
+            if swapper is not None:
+                swapper.join()
+
+        n = 30 if QUICK else 150
+        drive(gaps_plain, n)
+        net.fit(ds)
+        cm_obj.save(net)  # the newer checkpoint the swap run picks up
+        drive(gaps_swap, n, swap_at=n // 2)
+        assert pi.stats()["hot_swap"]["swaps"] == 1
+        pi.shutdown()
+        cm_obj.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    swap_max_ms = max(gaps_swap) * 1000.0
+    emit("checkpoint_restore_latest_ms", local_ms, "ms", "resilience",
+         restore_local_ms=round(local_ms, 2),
+         restore_object_store_ms=round(object_ms, 2),
+         note="restore_latest wall time, LocalFSBackend vs in-process "
+              "ObjectStoreBackend (manifest walk + sha256 + zip + device "
+              "placement; the object-store number isolates the non-disk "
+              "cost). " + _REPS_NOTE)
+    emit("serving_hot_swap_max_gap_ms", swap_max_ms, "ms", "resilience",
+         swaps=pi.stats()["hot_swap"]["swaps"],
+         served_step=pi.stats()["hot_swap"]["current_checkpoint_step"],
+         gap_p50_plain_ms=round(float(np.percentile(gaps_plain, 50)) * 1000,
+                                2),
+         gap_max_plain_ms=round(max(gaps_plain) * 1000, 2),
+         gap_p50_swap_ms=round(float(np.percentile(gaps_swap, 50)) * 1000,
+                               2),
+         note="max gap between consecutive served dispatches across a "
+              "checkpoint hot-swap vs the same client loop without one; "
+              "restore+placement runs off the dispatch path, only the "
+              "param pointer swap blocks. metrics only — thresholds on "
+              "quiet full runs.")
+
+
 def main():
     benches = [("lenet", bench_lenet), ("word2vec", bench_word2vec),
                ("charlstm", bench_graveslstm), ("serving", bench_serving),
                ("checkpoint", bench_checkpoint),
+               ("resilience", bench_resilience),
                ("resnet50_fusion", bench_resnet50_fusion),
                ("resnet50", bench_resnet50)]
     only = os.environ.get("BENCH_ONLY")

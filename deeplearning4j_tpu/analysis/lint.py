@@ -33,6 +33,15 @@ Rules (waivable per line with ``# lint: disable=DLT00X`` or per file with
   ``train=True`` call on that serving path would run BN-*train* semantics
   on request batches). Fold for serving, or waive inline like DLT003.
 
+- **DLT006 swallowed-storage-error**: in checkpoint/storage code paths
+  (``checkpoint/``, ``storage/`` files), an ``except Exception:`` /
+  ``except BaseException:`` / bare ``except:`` handler that neither
+  re-raises, nor logs, nor stashes the exception for later re-raise
+  silently eats exactly the durability faults this subsystem exists to
+  surface — a checkpoint that "saved" into a swallowed error is a run
+  that dies at restore time. Narrow the handler, log it, or waive inline
+  like DLT003.
+
 Adding a rule: write a ``_rule_xxx(tree, src, path) -> List[LintViolation]``
 function and register it in ``_RULES``; tests in ``tests/test_lint.py``
 seed a fixture violating the rule and assert it fires.
@@ -380,6 +389,70 @@ def _rule_serving_bn_fold(tree, src, path) -> List[LintViolation]:
         "ParallelInference(fold_bn=True)") for line in pi_lines]
 
 
+# ------------------------------------------------------------------ DLT006
+def _is_storage_file(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return any(seg in p for seg in ("checkpoint/", "storage/")) \
+        or os.path.basename(p) in ("storage.py", "checkpoint.py")
+
+
+_BROAD_EXC = ("Exception", "BaseException")
+
+
+def _rule_swallowed_storage_error(tree, src, path) -> List[LintViolation]:
+    if not _is_storage_file(path):
+        return []
+    out: List[LintViolation] = []
+
+    def handler_is_broad(h: ast.ExceptHandler) -> bool:
+        if h.type is None:  # bare except
+            return True
+        types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+        for t in types:
+            d = _dotted(t) or ""
+            if d.rsplit(".", 1)[-1] in _BROAD_EXC:
+                return True
+        return False
+
+    # the CALLED METHOD itself must be a reporting primitive — matching a
+    # substring anywhere in the dotted path would let `self.catalog.
+    # refresh()` (…log…) silence the rule
+    _REPORTERS = {"debug", "info", "warning", "warn", "error", "exception",
+                  "critical", "log", "print", "_fail"}
+
+    def handler_surfaces(h: ast.ExceptHandler) -> bool:
+        """Re-raise, log, warn, or stash the bound exception somewhere."""
+        bound = h.name
+        for node in ast.walk(h):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                d = (_dotted(node.func) or "").lower()
+                if d.rsplit(".", 1)[-1] in _REPORTERS:
+                    return True
+            # ``self._write_err = e`` — deferred re-raise pattern
+            if bound and isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == bound:
+                return True
+        return False
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for h in node.handlers:
+            if handler_is_broad(h) and not handler_surfaces(h):
+                what = ("bare except" if h.type is None else
+                        f"except {_dotted(h.type) if not isinstance(h.type, ast.Tuple) else 'Exception'}")
+                out.append(LintViolation(
+                    path, h.lineno, "DLT006",
+                    f"{what} in checkpoint/storage code swallows the error "
+                    "without re-raising or logging — a durability fault "
+                    "eaten here surfaces as a dead run at restore time; "
+                    "narrow the handler, log it, or waive inline"))
+    return out
+
+
 # ----------------------------------------------------------------- harness
 _RULES = (
     _rule_module_level_jnp,
@@ -387,6 +460,7 @@ _RULES = (
     _rule_bench_sync,
     _rule_lock_order,
     _rule_serving_bn_fold,
+    _rule_swallowed_storage_error,
 )
 
 

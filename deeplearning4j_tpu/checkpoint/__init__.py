@@ -1,20 +1,30 @@
 """checkpoint/ — asynchronous, crash-consistent checkpointing with
-exact-step resume.
+exact-step resume, pluggable storage and automatic recovery.
 
-Three cooperating pieces (see each module's docstring):
+Five cooperating pieces (see each module's docstring):
 
 - ``manager``  — CheckpointManager: host snapshot on the training thread,
                  async atomic journaled commits, retention, triggers,
                  multi-host barrier, ``restore_latest``/``restore_best``
                  with fall-back past torn files, early-stopping saver
                  protocol;
-- ``manifest`` — the checksummed journal + tmp/fsync/rename commit
-                 primitives that make a torn write detectable;
-- ``faults``   — FaultInjector / tear_file / flip_byte: the crash and
-                 corruption simulators the resume-bitwise tests drive.
+- ``manifest`` — the checksummed journal + atomic commit primitives that
+                 make a torn write detectable through any backend;
+- ``storage``  — the StorageBackend interface: LocalFSBackend (default),
+                 ObjectStoreBackend (GCS-style put/get/list/delete) and
+                 RetryingBackend (bounded exponential-backoff-with-jitter
+                 retries + per-op timeouts for transient faults);
+- ``resume``   — ``train_until``: the auto-resume driver looping
+                 restore_latest + fit under a restart budget, turning
+                 preemption into a no-op for callers;
+- ``faults``   — the chaos harness: FaultInjector (step / epoch-boundary /
+                 probabilistic kills), FlakyBackend (seeded storage
+                 faults + latency), tear/flip corruption simulators.
 
 Wired end-to-end as ``fit(..., checkpoint_manager=cm)`` on
-MultiLayerNetwork, ComputationGraph, ParallelWrapper and ClusterTrainer.
+MultiLayerNetwork, ComputationGraph, ParallelWrapper and ClusterTrainer;
+serving picks new checkpoints up live via
+``ParallelInference.start_hot_swap``.
 """
 
 from deeplearning4j_tpu.checkpoint.manager import (  # noqa: F401
@@ -25,13 +35,33 @@ from deeplearning4j_tpu.checkpoint.manager import (  # noqa: F401
 )
 from deeplearning4j_tpu.checkpoint.faults import (  # noqa: F401
     FaultInjector,
+    FlakyBackend,
     SimulatedCrash,
     flip_byte,
+    flip_object_byte,
     tear_file,
+    tear_object,
 )
 from deeplearning4j_tpu.checkpoint.manifest import (  # noqa: F401
     ManifestError,
     file_sha256,
     load_manifest,
     scan_checkpoint_files,
+)
+from deeplearning4j_tpu.checkpoint.storage import (  # noqa: F401
+    LocalFSBackend,
+    ObjectStoreBackend,
+    PermanentStorageError,
+    RetryingBackend,
+    StorageBackend,
+    StorageError,
+    StorageNotFoundError,
+    TransientStorageError,
+)
+from deeplearning4j_tpu.checkpoint.resume import (  # noqa: F401
+    CrashRecord,
+    RestartBudgetExceeded,
+    RestartPolicy,
+    RunSummary,
+    train_until,
 )

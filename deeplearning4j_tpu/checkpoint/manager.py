@@ -12,10 +12,14 @@ resumable training:
   bounded queue hands the snapshot to a writer thread, so the step loop
   never blocks on disk. ``async_write=False`` degrades to synchronous
   commits (deterministic tests, worst-case-overhead benching).
-- **Atomic, journaled commits.** Bytes go to ``tmp/`` + fsync + rename,
-  then the entry (with the file's sha256) is journaled into a checksummed
-  ``manifest.json`` (checkpoint/manifest.py). A torn write is detected, and
-  ``restore_latest`` falls back to the last complete checkpoint.
+- **Atomic, journaled commits through pluggable storage.** Bytes are
+  committed atomically by a :class:`~deeplearning4j_tpu.checkpoint.storage.
+  StorageBackend` (local tmp/ + fsync + rename by default; GCS-style
+  object stores via ``ObjectStoreBackend``; transient-fault retries via
+  ``RetryingBackend``), then the entry (with the payload's sha256) is
+  journaled into a checksummed ``manifest.json`` (checkpoint/manifest.py).
+  A torn write is detected, and ``restore_latest`` falls back to the last
+  complete checkpoint — identically through any backend.
 - **Retention.** ``keep_last=N`` bounds disk; ``keep_best`` ("min"/"max"
   over the ``metric`` passed to ``save``) pins the best checkpoint outside
   that window.
@@ -85,9 +89,19 @@ class CheckpointManager:
         cm = CheckpointManager("ckpts")      # fresh process
         net = cm.restore_latest()            # falls back past torn files
         net.fit(data, num_epochs=10, checkpoint_manager=cm)  # exact resume
+
+    or, restart-proof end to end (checkpoint/resume.py turns the crash +
+    restore + refit loop into one call)::
+
+        train_until(net, data, num_epochs=10, checkpoint_manager=cm)
+
+    ``storage`` accepts any checkpoint/storage.py backend — e.g.
+    ``CheckpointManager(storage=RetryingBackend(ObjectStoreBackend(bucket)))``
+    lands checkpoints in an object store and rides out transient faults;
+    ``directory`` alone keeps the historical local-filesystem behavior.
     """
 
-    def __init__(self, directory: str,
+    def __init__(self, directory: Optional[str] = None,
                  save_every_n_steps: Optional[int] = None,
                  save_every_secs: Optional[float] = None,
                  keep_last: Optional[int] = None,
@@ -95,14 +109,17 @@ class CheckpointManager:
                  async_write: bool = True,
                  queue_depth: int = 2,
                  barrier_timeout_s: float = 300.0,
-                 save_updater: bool = True):
+                 save_updater: bool = True,
+                 storage=None):
         if save_every_n_steps is not None and save_every_n_steps < 1:
             raise ValueError("save_every_n_steps must be >= 1")
         if keep_best not in (None, "min", "max"):
             raise ValueError("keep_best must be None, 'min' or 'max'")
         if keep_last is not None and keep_last < 1:
             raise ValueError("keep_last must be >= 1")
-        self.directory = str(directory)
+        if directory is None and storage is None:
+            raise ValueError("need a directory or a storage backend")
+        self.directory = None if directory is None else str(directory)
         self.save_every_n_steps = save_every_n_steps
         self.save_every_secs = save_every_secs
         self.keep_last = keep_last
@@ -111,28 +128,35 @@ class CheckpointManager:
         self.barrier_timeout_s = float(barrier_timeout_s)
         self.save_updater = bool(save_updater)
         from deeplearning4j_tpu.checkpoint import manifest as mf
+        from deeplearning4j_tpu.checkpoint.storage import LocalFSBackend
         self._mf = mf
-        os.makedirs(self.directory, exist_ok=True)
-        mf.clean_tmp(self.directory)  # orphans from a crash mid-write
+        # ``storage`` (checkpoint/storage.py StorageBackend) decouples the
+        # journal + payloads from the local filesystem: LocalFSBackend is
+        # the historical default, ObjectStoreBackend lands checkpoints in a
+        # GCS-style store, RetryingBackend rides transient faults out
+        self._storage = (storage if storage is not None
+                         else LocalFSBackend(self.directory))
+        if self.directory is not None and storage is None:
+            os.makedirs(self.directory, exist_ok=True)
+        self._storage.clean_orphans()  # partial writes from a crash
         self._lock = threading.Lock()          # guards _entries + manifest
         try:
-            entries = mf.load_manifest(self.directory)
+            entries = mf.load_manifest(self._storage)
         except mf.ManifestError as e:
-            log.warning("%s — rebuilding from directory scan", e)
+            log.warning("%s — rebuilding from storage scan", e)
             entries = None
-        if entries is None and mf.scan_checkpoint_files(self.directory):
+        if entries is None and mf.scan_checkpoint_files(self._storage):
             # torn OR missing manifest over surviving checkpoint files:
             # rebuild the journal — sha recomputed AND the per-entry
             # metadata (step/metric/...) read back out of each zip, so
             # restore_best / retention / checkpoints() keep working after
             # the rebuild, not just restore_latest
             entries = []
-            for e_ in mf.scan_checkpoint_files(self.directory):
-                path = os.path.join(self.directory, e_["file"])
-                rebuilt = self._entry_from_file(path, e_["file"])
+            for e_ in mf.scan_checkpoint_files(self._storage):
+                rebuilt = self._entry_from_object(e_["file"])
                 if rebuilt is not None:
                     entries.append(rebuilt)
-            mf.write_manifest(self.directory, entries)
+            mf.write_manifest(self._storage, entries)
         self._entries: List[dict] = entries or []
         self._seq = max((int(e.get("seq", 0)) for e in self._entries),
                         default=0)
@@ -146,19 +170,22 @@ class CheckpointManager:
         self._worker: Optional[threading.Thread] = None
         self._queue_depth = max(1, int(queue_depth))
         self._write_err: Optional[BaseException] = None
+        self._fenced_model = None
         self.saves_requested = 0
+        self.saves_fenced = 0
         self.saves_committed = 0
 
-    @staticmethod
-    def _entry_from_file(path: str, filename: str) -> Optional[dict]:
+    def _entry_from_object(self, filename: str) -> Optional[dict]:
         """Reconstruct a full journal entry from a checkpoint zip's own
-        metadata (manifest-rebuild path); None if the file is unreadable."""
+        metadata (manifest-rebuild path); None if the object is unreadable."""
+        import hashlib as _hashlib
+        import io
         import json
         import zipfile
-        from deeplearning4j_tpu.checkpoint import manifest as mf
         try:
-            sha = mf.file_sha256(path)
-            with zipfile.ZipFile(path, "r") as z:
+            data = self._storage.get(filename)
+            sha = _hashlib.sha256(data).hexdigest()
+            with zipfile.ZipFile(io.BytesIO(data), "r") as z:
                 meta = json.loads(z.read("metadata.json"))
             return {
                 "file": filename,
@@ -169,7 +196,7 @@ class CheckpointManager:
                 "metric": meta.get("metric"),
                 "wall_time": meta.get("wall_time"),
                 "sha256": sha,
-                "size": os.path.getsize(path),
+                "size": len(data),
             }
         except Exception as e:
             log.warning("skipping unreadable checkpoint %s during manifest "
@@ -196,6 +223,8 @@ class CheckpointManager:
         """Called by ``fit`` after every optimizer step (``model.iteration``
         already incremented). ``batch_in_epoch`` is the number of batches
         consumed so far in the CURRENT epoch — what exact-step resume skips."""
+        if self._fenced_model is not None and model is not self._fenced_model:
+            return  # stale thread: must not touch triggers or resume state
         if batch_in_epoch is not None:
             self._batch_in_epoch = int(batch_in_epoch)
         n = self.save_every_n_steps
@@ -211,18 +240,39 @@ class CheckpointManager:
     def epoch_end(self, model):
         """Epoch boundary: resume state resets to batch 0 of the (already
         incremented) next epoch; the time trigger may still fire."""
+        if self._fenced_model is not None and model is not self._fenced_model:
+            return  # stale thread: must not touch triggers or resume state
         self._batch_in_epoch = 0
         if self.save_every_secs is not None and self._secs_trigger_due():
             self.save(model)
+
+    # ------------------------------------------------------------------ fence
+    def fence(self, model):
+        """Accept saves only from ``model`` from now on (``None`` lifts the
+        fence). The auto-resume driver re-fences to each restored model:
+        an ABANDONED fit thread (a watchdog-timed-out attempt that cannot
+        be cancelled, only outlived) may wake later and try to checkpoint
+        its stale lineage through this same manager — the fence drops
+        those commits instead of letting them become ``restore_latest``'s
+        newest entry behind the recovered run's back."""
+        self._fenced_model = model
 
     # ------------------------------------------------------------------- save
     def save(self, model, metric: Optional[float] = None,
              wait: bool = False) -> Optional[str]:
         """Snapshot ``model`` and commit it (async by default). Returns the
-        checkpoint filename on the writer process, ``None`` on non-writers.
+        checkpoint filename on the writer process, ``None`` on non-writers
+        and on fenced-out models (see :meth:`fence`).
         ``metric`` (lower/higher better per ``keep_best``) feeds best-model
         retention and ``restore_best``."""
         import jax
+        if self._fenced_model is not None and model is not self._fenced_model:
+            self.saves_fenced += 1
+            log.warning(
+                "dropping checkpoint save from a fenced-out model (stale "
+                "lineage — an abandoned fit thread?); the manager is "
+                "fenced to a different model object")
+            return None
         self._raise_pending()
         # reset BOTH trigger watermarks on EVERY process (a non-writer
         # whose watermarks never advanced would re-trigger each step and
@@ -315,8 +365,8 @@ class CheckpointManager:
         sha = hashlib.sha256(data).hexdigest()
         # fsync_directory deferred to the manifest write below (same dir):
         # the journal entry can never become durable before the payload
-        self._mf.atomic_write_bytes(self.directory, filename, data,
-                                    fsync_directory=False)
+        # (a local-fs hint; object-store puts are durable on return)
+        self._storage.put(filename, data, fsync_directory=False)
         entry = {
             "file": filename,
             "seq": extra["seq"],
@@ -331,7 +381,7 @@ class CheckpointManager:
         with self._lock:
             self._entries.append(entry)
             self._entries = self._apply_retention(self._entries)
-            self._mf.write_manifest(self.directory, self._entries)
+            self._mf.write_manifest(self._storage, self._entries)
         self.saves_committed += 1
 
     def _best_entry(self, entries: List[dict],
@@ -354,11 +404,14 @@ class CheckpointManager:
         kept, pruned = [], []
         for e in entries:
             (kept if id(e) in keep else pruned).append(e)
+        from deeplearning4j_tpu.checkpoint.storage import StorageError
         for e in pruned:
             try:
-                os.remove(os.path.join(self.directory, e["file"]))
-            except OSError:
-                pass  # retention is best-effort; the manifest is truth
+                self._storage.delete(e["file"])
+            except (OSError, StorageError) as err:
+                # retention is best-effort; the manifest is truth
+                log.warning("retention could not delete %s (%s: %s)",
+                            e["file"], type(err).__name__, err)
         return kept
 
     # ---------------------------------------------------------------- control
@@ -403,24 +456,56 @@ class CheckpointManager:
         with self._lock:
             return [dict(e) for e in self._entries]
 
+    def refresh(self) -> int:
+        """Re-read the journal from storage and adopt it — for READ-side
+        managers watching a store another process writes to (the serving
+        hot-swap poller). Returns the number of entries now known. A torn
+        or unreadable manifest keeps the previously-known entries (a
+        reader must never go blind because it raced the writer's atomic
+        manifest replace)."""
+        from deeplearning4j_tpu.checkpoint.storage import StorageError
+        try:
+            entries = self._mf.load_manifest(self._storage)
+        except (self._mf.ManifestError, StorageError, OSError) as e:
+            log.warning("manifest refresh failed (%s: %s) — keeping the "
+                        "previously loaded journal", type(e).__name__, e)
+            entries = None
+        with self._lock:
+            if entries is not None:
+                self._entries = entries
+                self._seq = max((int(e.get("seq", 0)) for e in self._entries),
+                                default=self._seq)
+            return len(self._entries)
+
+    def latest_step(self) -> Optional[int]:
+        """Step of the newest committed checkpoint, ``None`` when empty —
+        the cheap "is there something newer?" probe hot-swap polls."""
+        with self._lock:
+            if not self._entries:
+                return None
+            return int(self._entries[-1].get("step", 0))
+
     # ---------------------------------------------------------------- restore
     def _restorable_entries(self) -> List[dict]:
         with self._lock:
             if self._entries:
                 return [dict(e) for e in self._entries]
-        return self._mf.scan_checkpoint_files(self.directory)
+        return self._mf.scan_checkpoint_files(self._storage)
 
     def _try_restore(self, entry: dict, load_updater: bool,
                      arm_resume: bool):
-        path = os.path.join(self.directory, entry["file"])
-        if not os.path.exists(path):
-            raise FileNotFoundError(path)
+        import io
+        data = self._storage.get(entry["file"])  # StorageNotFoundError if gone
         if entry.get("sha256") is not None and \
-                self._mf.file_sha256(path) != entry["sha256"]:
+                hashlib.sha256(data).hexdigest() != entry["sha256"]:
             raise CheckpointError(
                 f"checksum mismatch for {entry['file']} (torn/corrupt write)")
         from deeplearning4j_tpu.utils.serialization import restore_checkpoint
-        model, meta = restore_checkpoint(path, load_updater=load_updater)
+        model, meta = restore_checkpoint(io.BytesIO(data),
+                                         load_updater=load_updater)
+        path = (os.path.join(self.directory, entry["file"])
+                if self.directory is not None
+                else f"{self._storage.describe()}/{entry['file']}")
         info = ResumeState(
             step=int(meta.get("iteration", 0)),
             epoch=int(meta.get("epoch", 0)),

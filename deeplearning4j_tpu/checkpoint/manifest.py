@@ -2,19 +2,27 @@
 
 Crash consistency contract (checkpoint/manager.py is the only writer):
 
-1. checkpoint bytes are written to ``<dir>/tmp/``, fsync'd, then
-   ``os.replace``'d to their final name (atomic on POSIX) and the directory
-   is fsync'd — a crash mid-write leaves only a ``tmp/`` orphan, never a
-   half-written ``ckpt-*.zip``;
-2. only AFTER the file is durable is its entry (with the file's sha256)
-   journaled into ``manifest.json``, itself rewritten atomically with an
-   embedded checksum over the entries payload.
+1. checkpoint bytes are committed atomically through a
+   :class:`~deeplearning4j_tpu.checkpoint.storage.StorageBackend` — on the
+   local filesystem that is ``<dir>/tmp/`` + fsync + ``os.replace`` (atomic
+   on POSIX) + directory fsync, so a crash mid-write leaves only a ``tmp/``
+   orphan, never a half-written ``ckpt-*.zip``; on an object store a put is
+   whole-object atomic by construction;
+2. only AFTER the payload is durable is its entry (with the payload's
+   sha256) journaled into ``manifest.json``, itself rewritten atomically
+   with an embedded checksum over the entries payload.
 
-So at every instant the manifest describes only fully-committed files, and a
-torn manifest or a bit-rotted checkpoint is DETECTED (self-checksum /
+So at every instant the manifest describes only fully-committed objects,
+and a torn manifest or a bit-rotted checkpoint is DETECTED (self-checksum /
 per-entry sha256) instead of restored: ``restore_latest`` falls back entry
 by entry, and a missing or corrupt manifest degrades to scanning the
-directory, where the zip layer's CRC checks still reject torn files.
+backend, where the zip layer's CRC checks still reject torn payloads. The
+journal/fallback logic is identical through every backend — only the five
+byte-store ops differ.
+
+``load_manifest`` / ``write_manifest`` / ``scan_checkpoint_files`` accept a
+directory path (wrapped in a LocalFSBackend, the historical signature) or
+any ``StorageBackend``.
 
 Reference analogue: none — DL4J's CheckpointListener writes in place with
 no journal; a crash mid-save loses the run. This is part of the durability
@@ -112,41 +120,60 @@ def clean_tmp(directory: str):
             pass
 
 
-def write_manifest(directory: str, entries: List[dict]):
-    """Atomically rewrite the journal with a self-checksum over its entries."""
+def _as_backend(target):
+    # deferred import: storage.py imports atomic_write_bytes from here
+    from deeplearning4j_tpu.checkpoint.storage import as_backend
+    return as_backend(target)
+
+
+def write_manifest(target, entries: List[dict]):
+    """Atomically rewrite the journal with a self-checksum over its entries.
+    ``target`` is a directory path or a StorageBackend."""
     body = {"version": MANIFEST_VERSION, "entries": entries,
             "checksum": _entries_checksum(entries)}
-    atomic_write_bytes(directory, MANIFEST_NAME,
-                       json.dumps(body, indent=1).encode())
+    _as_backend(target).put(MANIFEST_NAME,
+                            json.dumps(body, indent=1).encode())
 
 
-def load_manifest(directory: str) -> Optional[List[dict]]:
+def load_manifest(target) -> Optional[List[dict]]:
     """Entries from the journal; ``None`` when no manifest exists yet.
     Raises :class:`ManifestError` on a torn/corrupt manifest — callers fall
-    back to :func:`scan_checkpoint_files`."""
-    path = os.path.join(directory, MANIFEST_NAME)
-    if not os.path.exists(path):
-        return None
+    back to :func:`scan_checkpoint_files`. ``target`` is a directory path
+    or a StorageBackend."""
+    from deeplearning4j_tpu.checkpoint.storage import (StorageError,
+                                                       StorageNotFoundError)
+    backend = _as_backend(target)
     try:
-        with open(path, "r") as f:
-            body = json.load(f)
+        raw = backend.get(MANIFEST_NAME)
+    except StorageNotFoundError:
+        return None
+    except (OSError, StorageError) as e:
+        # present-but-unreadable (EACCES/EIO on a flaky mount, a store
+        # outage): surface as a torn manifest so the manager falls back to
+        # its rebuild-from-scan path instead of failing construction
+        raise ManifestError(
+            f"unreadable manifest at {backend.describe()}/{MANIFEST_NAME}: "
+            f"{type(e).__name__}: {e}") from e
+    try:
+        body = json.loads(raw.decode("utf-8"))
         entries = body["entries"]
         if not isinstance(entries, list):
             raise TypeError("entries is not a list")
         if body.get("checksum") != _entries_checksum(entries):
             raise ValueError("manifest self-checksum mismatch")
-    except (ValueError, KeyError, TypeError, OSError) as e:
-        raise ManifestError(f"corrupt manifest at {path}: {e}") from e
+    except (ValueError, KeyError, TypeError, UnicodeDecodeError) as e:
+        raise ManifestError(
+            f"corrupt manifest at {backend.describe()}/{MANIFEST_NAME}: "
+            f"{e}") from e
     return entries
 
 
-def scan_checkpoint_files(directory: str) -> List[dict]:
+def scan_checkpoint_files(target) -> List[dict]:
     """Degraded-mode recovery: entries (without sha256) for every
-    ``ckpt-*.zip`` present, in filename (= commit) order. Used when the
+    ``ckpt-*.zip`` present, in name (= commit) order. Used when the
     manifest itself was lost or torn; the zip CRC layer still guards each
-    file's integrity during restore."""
-    if not os.path.isdir(directory):
-        return []
-    names = sorted(n for n in os.listdir(directory)
-                   if n.startswith("ckpt-") and n.endswith(".zip"))
-    return [{"file": n, "sha256": None} for n in names]
+    payload's integrity during restore. ``target`` is a directory path or
+    a StorageBackend."""
+    names = _as_backend(target).list(prefix="ckpt-")
+    return [{"file": n, "sha256": None} for n in names
+            if n.endswith(".zip")]

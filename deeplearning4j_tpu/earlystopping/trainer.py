@@ -52,7 +52,12 @@ class EarlyStoppingTrainer:
     become durable, checksummed, retention-bounded checkpoints (the
     manager implements the saver protocol — save_best_model /
     save_latest_model / get_best_model via restore_best). Passing one
-    overrides ``config.model_saver``.
+    overrides ``config.model_saver``. The manager's ``storage=`` backend
+    carries through unchanged — a manager built over
+    ``RetryingBackend(ObjectStoreBackend(...))`` gives early stopping
+    object-store durability with transient-fault retries for free, and
+    ``get_best_model`` inherits restore_best's corruption fallback
+    (next-best checkpoint, never garbage).
     """
 
     def __init__(self, config: EarlyStoppingConfiguration, model,
@@ -147,6 +152,18 @@ class EarlyStoppingTrainer:
                 break
             epoch += 1
         best_model = self.config.model_saver.get_best_model(self.model)
+        if best_model is None and best_epoch >= 0:
+            # a best model WAS saved but the saver cannot hand it back
+            # (every checkpoint torn/corrupt, or the store lost them):
+            # degrade to the live in-memory model rather than returning
+            # None for a run that demonstrably trained — loudly, because
+            # the live model is the LAST state, not the best-scoring one
+            log.warning(
+                "model saver could not return the saved best model (epoch "
+                "%d, score %.6g) — storage fault or pruned checkpoint; "
+                "falling back to the live final-state model", best_epoch,
+                best_score)
+            best_model = self.model
         return EarlyStoppingResult(
             termination_reason=reason,
             termination_details=details,

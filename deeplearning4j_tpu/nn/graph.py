@@ -486,6 +486,11 @@ class ComputationGraph:
         epochs_to_run, skip = resume_plan(self, num_epochs)
         step = self._get_jitted("train")
         for _ in range(epochs_to_run):
+            # epoch-boundary listener hooks: MLN parity (epoch-scoped
+            # listeners — and the chaos harness's epoch-boundary fault
+            # injection — were MLN-only before)
+            for listener in self.listeners:
+                listener.on_epoch_start(self)
             # skip UNDER the prefetch wrapper: already-consumed batches are
             # never transferred just to be discarded (no rng split, no
             # update — the restored chain stays exact)
@@ -500,6 +505,8 @@ class ComputationGraph:
                 if checkpoint_manager is not None:
                     checkpoint_manager.step_end(self, batch_in_epoch=bi)
             skip = 0
+            for listener in self.listeners:
+                listener.on_epoch_end(self)
             self.epoch += 1
             if checkpoint_manager is not None:
                 checkpoint_manager.epoch_end(self)

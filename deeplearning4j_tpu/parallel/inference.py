@@ -79,16 +79,32 @@ class ParallelInference:
     every Conv→BatchNorm pair collapses into the conv's weights/bias, so
     serving dispatches pay no per-request normalize traffic at all. The
     caller's model object is untouched; exact within fp tolerance
-    (analysis/lint.py DLT005 flags serving sites that skip this)."""
+    (analysis/lint.py DLT005 flags serving sites that skip this).
+
+    checkpoint hot-swap: ``start_hot_swap(checkpoint_manager)`` watches the
+    manager's journal for a newer step and atomically swaps the new params
+    in BETWEEN dispatches — no request is dropped, none observes a
+    mid-batch mix of old and new weights, and because only param VALUES
+    change (same model object, same bucketed shapes), the warmed compiled
+    programs are reused: a swap compiles nothing. ``stats()["hot_swap"]``
+    reports swap count and the step currently being served."""
 
     _DEFAULT_POLICY = object()
 
     def __init__(self, model, mesh=None, batch_limit: int = 32,
                  queue_timeout_ms: int = 5, inference_mode: str = "batched",
                  bucket_policy=_DEFAULT_POLICY,
-                 batch_size_history: int = 1024, fold_bn: bool = False):
+                 batch_size_history: int = 1024, fold_bn: bool = False,
+                 checkpoint_manager=None,
+                 checkpoint_poll_secs: Optional[float] = None):
         if inference_mode not in ("batched", "sequential"):
             raise ValueError(f"unknown inference_mode '{inference_mode}'")
+        self._fold_bn = bool(fold_bn)
+        # read checkpoint provenance BEFORE folding: fold_bn rebuilds the
+        # model and does not carry _restored_from over, and losing it here
+        # would make the first hot-swap poll re-swap the very checkpoint
+        # this server already serves
+        restored_from = getattr(model, "_restored_from", None)
         if fold_bn:
             from deeplearning4j_tpu.perf.fusion import fold_bn as _fold_bn
             model = _fold_bn(model)
@@ -127,6 +143,20 @@ class ParallelInference:
         # sequential mode dispatches on arbitrary caller threads: counter
         # updates are read-modify-write and need the lock
         self._stats_lock = threading.Lock()
+        # hot-swap: _model_lock serializes device dispatches against param
+        # swaps — a swap waits for the in-flight batch and the next batch
+        # sees the new params, so no dispatch ever runs a mid-swap mix
+        self._model_lock = threading.Lock()
+        self._swap_cm = None
+        self._swap_thread: Optional[threading.Thread] = None
+        self._swap_stop = threading.Event()
+        self.swaps = 0
+        self.swap_poll_errors = 0
+        self.current_checkpoint_step = (None if restored_from is None
+                                        else int(restored_from.step))
+        if checkpoint_manager is not None:
+            self.start_hot_swap(checkpoint_manager,
+                                poll_secs=checkpoint_poll_secs)
 
     # --------------------------------------------------------- shape policy
     def _pad_target(self, n: int) -> int:
@@ -161,7 +191,11 @@ class ParallelInference:
             if record:
                 self._record_dispatch_shape(target, n)
             arr = jax.device_put(arr, data_sharding(self.mesh, arr.ndim))
-            out = self.model.output(arr)
+            # _model_lock: a checkpoint hot-swap can never land mid-batch —
+            # it waits here for the in-flight dispatch, and the very next
+            # dispatch serves the new params
+            with self._model_lock:
+                out = self.model.output(arr)
             return out[:n] if target != n else out
 
     def output(self, x) -> np.ndarray:
@@ -222,6 +256,102 @@ class ParallelInference:
                 "row_sizes) before learning a bucket ladder")
         return BucketPolicy.from_histogram(rows, max_compiles=max_compiles)
 
+    # ------------------------------------------------- checkpoint hot-swap
+    def start_hot_swap(self, checkpoint_manager,
+                       poll_secs: Optional[float] = None):
+        """Serve newer checkpoints without dropping traffic: watch
+        ``checkpoint_manager``'s journal and swap params in atomically
+        between dispatches when a newer step commits.
+
+        With ``poll_secs`` a daemon poller calls :meth:`poll_checkpoint`
+        on that cadence; leave it ``None`` to poll manually (deterministic
+        tests, or an external control plane deciding when to roll). The
+        manager may point at the same store a TRAINING process writes to
+        (its journal is re-read via ``refresh()`` each poll), which is the
+        deployment shape: trainer commits, servers pick it up live."""
+        self._swap_cm = checkpoint_manager
+        if poll_secs is not None and self._swap_thread is None:
+            self._swap_stop.clear()
+            self._swap_thread = threading.Thread(
+                target=self._hot_swap_loop, args=(float(poll_secs),),
+                name="ckpt-hot-swap", daemon=True)
+            self._swap_thread.start()
+        return self
+
+    def stop_hot_swap(self):
+        self._swap_stop.set()
+        t, self._swap_thread = self._swap_thread, None
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+
+    def _hot_swap_loop(self, poll_secs: float):
+        while not self._swap_stop.wait(poll_secs):
+            try:
+                self.poll_checkpoint()
+            except Exception:
+                # the serving path must outlive a broken store; the error
+                # count is surfaced in stats() for alerting
+                with self._stats_lock:
+                    self.swap_poll_errors += 1
+                import logging
+                logging.getLogger(__name__).exception(
+                    "checkpoint hot-swap poll failed; serving continues "
+                    "on the current params")
+
+    def poll_checkpoint(self) -> bool:
+        """One hot-swap probe: is there a newer committed checkpoint than
+        the step being served? If so, restore it OFF the dispatch path,
+        then atomically swap params/state in between dispatches. Returns
+        whether a swap happened.
+
+        The swap reuses everything already compiled: the model OBJECT (and
+        its jit cache, warmed buckets, compile counters) is untouched —
+        only param/state VALUES change, at unchanged shapes, so the warmup
+        ladder stays valid and the swap compiles nothing new."""
+        cm = self._swap_cm
+        if cm is None:
+            return False
+        cm.refresh()
+        step = cm.latest_step()
+        if step is None or (self.current_checkpoint_step is not None
+                            and step <= self.current_checkpoint_step):
+            return False
+        # the expensive part — fetch + deserialize + (maybe) fold + device
+        # placement — happens OUTSIDE the model lock: traffic keeps being
+        # served on the old params while the new ones are prepared
+        restored = cm.restore_latest(load_updater=False)
+        if restored is None:
+            return False
+        # restore_latest may have FALLEN BACK past a torn/corrupt newest
+        # entry to a checkpoint at-or-before the one being served — without
+        # this guard a rotted newest object would re-swap (or DOWNGRADE to
+        # an older surviving checkpoint) on every poll, forever
+        restored_step = restored._restored_from.step
+        if self.current_checkpoint_step is not None \
+                and restored_step <= self.current_checkpoint_step:
+            return False
+        if self._fold_bn:
+            from deeplearning4j_tpu.perf.fusion import fold_bn as _fold_bn
+            restored = _fold_bn(restored)
+        if (jax.tree_util.tree_structure(restored.params)
+                != jax.tree_util.tree_structure(self.model.params)):
+            raise RuntimeError(
+                "hot-swap checkpoint params have a different structure "
+                "than the serving model — the store holds a different "
+                "architecture; refusing to swap")
+        repl = jax.tree_util.tree_map(lambda a: replicated(self.mesh),
+                                      restored.params)
+        new_params = jax.device_put(restored.params, repl)
+        new_state = restored.state
+        new_step = restored_step
+        with self._model_lock:
+            self.model.params = new_params
+            self.model.state = new_state
+        with self._stats_lock:
+            self.swaps += 1
+            self.current_checkpoint_step = int(new_step)
+        return True
+
     @staticmethod
     def _size_summary(sizes) -> dict:
         summary = {"count": len(sizes)}
@@ -249,6 +379,9 @@ class ParallelInference:
             warmed = sorted(self._warmed)
             bucket_dispatches = dict(self.bucket_dispatches)
             unwarmed = self.unwarmed_dispatches
+            swaps = self.swaps
+            current_step = self.current_checkpoint_step
+            swap_errors = self.swap_poll_errors
         out = {
             "requests_served": requests_served,
             "batches_dispatched": batches_dispatched,
@@ -259,6 +392,12 @@ class ParallelInference:
             "warmed_buckets": warmed,
             "bucket_dispatches": bucket_dispatches,
             "unwarmed_dispatches": unwarmed,
+            "hot_swap": {
+                "enabled": self._swap_cm is not None,
+                "swaps": swaps,
+                "current_checkpoint_step": current_step,
+                "poll_errors": swap_errors,
+            },
         }
         cw = getattr(self.model, "compile_watch", None)
         if cw is not None:
@@ -318,6 +457,7 @@ class ParallelInference:
     def shutdown(self):
         """Stop the worker after draining; pending observables either get
         served by the final drain or failed, never left hanging."""
+        self.stop_hot_swap()
         with self._worker_lock:
             w = self._worker
             if w is not None and w.is_alive():

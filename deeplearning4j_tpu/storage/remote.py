@@ -13,9 +13,13 @@ from __future__ import annotations
 import json
 import logging
 import queue
+import random
 import threading
+import time
 import urllib.request
 from typing import Optional
+
+from deeplearning4j_tpu.utils.backoff import backoff_delay
 
 log = logging.getLogger(__name__)
 
@@ -25,17 +29,26 @@ DEFAULT_PATH = "remoteReceive"
 class RemoteUIStatsStorageRouter:
     """Same write surface as a StatsStorage (put_static_info/put_update) but
     records travel over HTTP to a UI server process — use it as the
-    ``storage`` of a StatsListener on training workers."""
+    ``storage`` of a StatsListener on training workers.
+
+    Retries use capped exponential backoff with jitter
+    (utils/backoff.py, the same policy checkpoint storage retries use):
+    ``retry_backoff_s`` is the base, ``max_backoff_s`` the cap. The old
+    linear ``base * (attempt + 1)`` schedule synchronized every worker's
+    retries against a recovering UI server into periodic load spikes."""
 
     _END = object()
 
     def __init__(self, url: str, max_retries: int = 10,
-                 retry_backoff_s: float = 0.5, queue_size: int = 256):
+                 retry_backoff_s: float = 0.5, max_backoff_s: float = 15.0,
+                 queue_size: int = 256, seed: Optional[int] = None):
         self.base = url.rstrip("/")
         if not self.base.endswith("/" + DEFAULT_PATH):
             self.base = f"{self.base}/{DEFAULT_PATH}"
         self.max_retries = max_retries
         self.retry_backoff_s = retry_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self._rng = random.Random(seed)
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
         self._shutdown = False
         self._failures = 0
@@ -64,11 +77,24 @@ class RemoteUIStatsStorageRouter:
         timeout) instead of the full retry budget. Returns True when every
         queued record was delivered; False if records were dropped."""
         self._shutdown = True
-        try:
-            self._q.put_nowait(self._END)  # full queue: worker exits via the
-        except queue.Full:                 # shutdown flag in its get loop
-            pass
-        self._thread.join(timeout)
+        # a FULL queue used to mean the _END sentinel was silently dropped
+        # and the worker only noticed shutdown via its 0.25s poll timeout —
+        # and only after the queue went briefly empty. Keep offering the
+        # sentinel while the worker drains: the first slot it frees takes
+        # it, so exit is prompt and deterministic instead of racing the
+        # poll loop.
+        deadline = time.monotonic() + timeout
+        enqueued = False
+        while not enqueued and self._thread.is_alive():
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                self._q.put(self._END, timeout=min(0.05, remaining))
+                enqueued = True
+            except queue.Full:
+                continue
+        self._thread.join(max(0.0, deadline - time.monotonic()))
         flushed = self._q.empty() and not self._thread.is_alive()
         if not flushed:
             log.warning("RemoteUIStatsStorageRouter shutdown before the "
@@ -77,7 +103,6 @@ class RemoteUIStatsStorageRouter:
 
     # --------------------------------------------------------------- worker
     def _worker(self):
-        import time
         while True:
             try:
                 item = self._q.get(timeout=0.25)
@@ -108,4 +133,6 @@ class RemoteUIStatsStorageRouter:
                                     "posts to %s (%s)", retries,
                                     self.base, e)
                     else:
-                        time.sleep(self.retry_backoff_s * (attempt + 1))
+                        time.sleep(backoff_delay(
+                            attempt, base_s=self.retry_backoff_s,
+                            cap_s=self.max_backoff_s, rng=self._rng))

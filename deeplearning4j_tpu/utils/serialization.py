@@ -164,11 +164,13 @@ def checkpoint_zip_bytes(snap: dict, extra_meta: dict = None) -> bytes:
     return buf.getvalue()
 
 
-def restore_checkpoint(path: str, load_updater: bool = True):
+def restore_checkpoint(path, load_updater: bool = True):
     """Restore a checkpoint zip to ``(model, meta)`` — like ``restore`` but
     also rehydrates the training PRNG key, so continuing ``fit`` follows the
-    exact rng split chain the interrupted run would have. Zip member reads
-    are CRC-checked, so a corrupted file raises rather than restoring
+    exact rng split chain the interrupted run would have. ``path`` is a
+    filesystem path or a binary file-like (the storage-backend restore path
+    hands in a BytesIO of the fetched object). Zip member reads are
+    CRC-checked, so a corrupted file raises rather than restoring
     silently-wrong params (the manifest layer above turns that into a
     fall-back to the previous checkpoint)."""
     import jax.numpy as jnp

@@ -102,6 +102,58 @@ def test_remote_stats_router_roundtrip():
         server.stop()
 
 
+def test_remote_stats_router_exponential_backoff_with_jitter(monkeypatch):
+    """Retry delays follow the shared capped-exponential-with-jitter policy
+    (utils/backoff.py), not the old linear ``base * (attempt + 1)`` ramp
+    that synchronized every worker's retries into load spikes."""
+    import deeplearning4j_tpu.storage.remote as remote_mod
+
+    def down(*a, **k):
+        raise OSError("server down")
+
+    delays = []
+    monkeypatch.setattr(remote_mod.urllib.request, "urlopen", down)
+    # patch the MODULE's view of time only (patching time.sleep itself
+    # would also capture this test's own waits)
+    import types
+    fake_time = types.SimpleNamespace(sleep=delays.append,
+                                      monotonic=time.monotonic)
+    monkeypatch.setattr(remote_mod, "time", fake_time)
+    router = RemoteUIStatsStorageRouter(
+        "http://localhost:1", max_retries=6, retry_backoff_s=0.1,
+        max_backoff_s=0.4, seed=0)
+    router.put_update({"x": 1})
+    deadline = time.monotonic() + 5
+    while len(delays) < 5 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(delays) == 5  # 6 attempts -> 5 sleeps
+    caps = [min(0.4, 0.1 * 2 ** i) for i in range(5)]
+    for d, cap in zip(delays, caps):
+        assert 0.5 * cap <= d <= cap  # jittered, bounded by the schedule
+    # capped: the tail never exceeds max_backoff_s
+    assert max(delays) <= 0.4
+    router.shutdown(timeout=2)
+
+
+def test_remote_stats_router_shutdown_with_full_queue_is_prompt():
+    """The shutdown race: with the queue FULL, the _END sentinel used to be
+    dropped and the worker lingered on its 0.25s poll loop. shutdown() now
+    keeps offering the sentinel while the worker drains, so the thread
+    exits promptly and deterministically."""
+    router = RemoteUIStatsStorageRouter(
+        "http://localhost:1",  # nothing listening: instant refusals
+        max_retries=1, retry_backoff_s=0.0, queue_size=3)
+    for i in range(8):  # overfill; extras drop with a warning
+        router.put_update({"i": i})
+    t0 = time.monotonic()
+    router.shutdown(timeout=10)
+    elapsed = time.monotonic() - t0
+    assert not router._thread.is_alive()
+    assert elapsed < 8  # bounded well under the timeout, not a poll crawl
+    with pytest.raises(RuntimeError):
+        router.put_update({"late": True})  # enqueue after shutdown refused
+
+
 # -------------------------------------------------------------- checkpoint
 def test_checkpoint_listener_retention_and_resume(tmp_path):
     cdir = str(tmp_path / "ckpts")

@@ -266,6 +266,100 @@ class TestServingBnFold:
         assert _lint(src) == []
 
 
+class TestSwallowedStorageError:
+    _SWALLOW = """
+        def commit(backend, name, data):
+            try:
+                backend.put(name, data)
+            except Exception:
+                pass
+    """
+
+    def test_fires_on_swallowed_except_in_checkpoint_path(self):
+        vs = _lint(self._SWALLOW,
+                   path="deeplearning4j_tpu/checkpoint/thing.py")
+        assert _rules(vs) == ["DLT006"]
+        assert "swallows" in vs[0].message
+
+    def test_fires_on_bare_except_in_storage_path(self):
+        vs = _lint("""
+            def fetch(b, n):
+                try:
+                    return b.get(n)
+                except:
+                    return None
+        """, path="deeplearning4j_tpu/storage/thing.py")
+        assert _rules(vs) == ["DLT006"]
+
+    def test_logging_the_error_is_clean(self):
+        vs = _lint("""
+            import logging
+            log = logging.getLogger(__name__)
+            def commit(backend, name, data):
+                try:
+                    backend.put(name, data)
+                except Exception as e:
+                    log.warning("put failed: %s", e)
+        """, path="deeplearning4j_tpu/checkpoint/thing.py")
+        assert vs == []
+
+    def test_reraise_is_clean(self):
+        vs = _lint("""
+            def commit(backend, name, data):
+                try:
+                    backend.put(name, data)
+                except Exception:
+                    raise RuntimeError("commit failed")
+        """, path="deeplearning4j_tpu/checkpoint/thing.py")
+        assert vs == []
+
+    def test_stashing_for_deferred_reraise_is_clean(self):
+        vs = _lint("""
+            class W:
+                def work(self, item):
+                    try:
+                        self._write(item)
+                    except BaseException as e:
+                        self._write_err = e
+        """, path="deeplearning4j_tpu/checkpoint/thing.py")
+        assert vs == []
+
+    def test_unrelated_call_with_log_substring_still_fires(self):
+        """Only a reporting CALL counts — `self.catalog.refresh()` has
+        'log' buried in an attribute name and must not silence the rule."""
+        vs = _lint("""
+            class C:
+                def commit(self, backend, name, data):
+                    try:
+                        backend.put(name, data)
+                    except Exception:
+                        self.catalog.refresh()
+        """, path="deeplearning4j_tpu/checkpoint/thing.py")
+        assert _rules(vs) == ["DLT006"]
+
+    def test_narrow_handler_is_clean(self):
+        vs = _lint("""
+            import os
+            def prune(path):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+        """, path="deeplearning4j_tpu/checkpoint/thing.py")
+        assert vs == []
+
+    def test_out_of_scope_file_is_clean(self):
+        vs = _lint(self._SWALLOW, path="deeplearning4j_tpu/nn/thing.py")
+        assert vs == []
+
+    def test_inline_waiver(self):
+        src = self._SWALLOW.replace(
+            "except Exception:",
+            "except Exception:  # lint: disable=DLT006 (probe, loss ok)")
+        assert _lint(src,
+                     path="deeplearning4j_tpu/checkpoint/thing.py") == []
+
+
 class TestFileWaiver:
     def test_disable_file(self):
         vs = _lint("""

@@ -234,6 +234,70 @@ def test_collective_watchdog():
     assert msgs and "deliberately slow" in msgs[0]
 
 
+def test_collective_watchdog_guard_paths():
+    """guard()'s full contract: an in-time body passes untouched (timer
+    cancelled, no callback); an expired body raises on exit EVEN IF it
+    eventually completed (the hang was real — finishing late must not mask
+    it); a body that raises its own error keeps that error (the guard
+    never shadows a real exception with its timeout)."""
+    import time as _time
+
+    from deeplearning4j_tpu.parallel.watchdog import (
+        CollectiveTimeoutError, CollectiveWatchdog,
+    )
+
+    # in-time: no raise, no on_timeout, value side effects intact
+    msgs = []
+    wd = CollectiveWatchdog(timeout_s=5.0, on_timeout=msgs.append)
+    ran = []
+    with wd.guard("fast section"):
+        ran.append(1)
+    assert ran == [1] and msgs == []
+
+    # expired-but-completed: the timer fired mid-body; the body then
+    # finished fine — exit must STILL raise (and must have delivered the
+    # diagnostic callback at fire time, not exit time)
+    wd2 = CollectiveWatchdog(timeout_s=0.15, on_timeout=msgs.append)
+    with pytest.raises(CollectiveTimeoutError) as ei:
+        with wd2.guard("slow but eventually fine"):
+            _time.sleep(0.5)
+            ran.append(2)
+    assert ran == [1, 2]  # body DID complete; the guard raised anyway
+    assert "slow but eventually fine" in str(ei.value)
+    assert len(msgs) == 1 and "slow but eventually fine" in msgs[0]
+
+    # body exception wins over a fired timer: never mask the real error
+    with pytest.raises(ValueError, match="real failure"):
+        with wd2.guard("failing section"):
+            _time.sleep(0.5)
+            raise ValueError("real failure")
+
+
+def test_collective_watchdog_call_on_timeout_delivery():
+    """call() paths: on_timeout fires with the diagnostic on expiry; a
+    worker-side exception is re-raised on the caller thread; the in-time
+    path returns the value with no callback."""
+    import time as _time
+
+    from deeplearning4j_tpu.parallel.watchdog import (
+        CollectiveTimeoutError, CollectiveWatchdog,
+    )
+
+    msgs = []
+    wd = CollectiveWatchdog(timeout_s=0.15, on_timeout=msgs.append)
+    with pytest.raises(CollectiveTimeoutError):
+        wd.call(lambda: _time.sleep(0.6), what="stuck dispatch")
+    assert msgs and "stuck dispatch" in msgs[0]
+    assert "process" in msgs[0]  # diagnostic includes process/device info
+
+    wd_ok = CollectiveWatchdog(timeout_s=5.0, on_timeout=msgs.append)
+    assert wd_ok.call(lambda: 41 + 1, what="quick") == 42
+
+    with pytest.raises(KeyError):  # body errors surface, not timeouts
+        wd_ok.call(lambda: {}[0], what="raising body")
+    assert len(msgs) == 1  # no extra callbacks from the healthy calls
+
+
 def test_cluster_trainer_watchdog_smoke():
     """fit_local_shard with an armed watchdog trains normally when healthy."""
     net = _net(seed=44)
