@@ -55,6 +55,7 @@ NOMINAL = {
     "serving": 10_000.0,    # req/sec, nominal GPU dynamic-batching server
     "checkpoint": 1_000.0,  # steps/sec, nominal small-model step loop
     "resilience": 100.0,    # ms, nominal small-model restore/swap budget
+    "elastic": 1_000.0,     # ms, nominal membership-transition budget
 }
 
 
@@ -695,11 +696,110 @@ def bench_resilience():
               "quiet full runs.")
 
 
+def bench_elastic():
+    """Elastic-training path costs, metrics only (no thresholds — the 9p
+    filesystem's fsync jitter swings disk-backed numbers run to run;
+    acceptance bars belong to quiet full runs): (1) sharded checkpoint
+    save (one epoch-boundary commit: shard snapshot + put + journal);
+    (2) reshard-on-restore latency — reassembling a 4-host shard set into
+    a 1-process world, the work a shrunk fleet does before training
+    resumes; (3) membership-transition pause — bump request → new
+    generation adopted → checkpoint restored, the storage-rendezvous part
+    of an elastic transition (the jax.distributed re-init a multi-process
+    world adds on top is measured by the slow chaos tests)."""
+    import jax
+
+    from deeplearning4j_tpu.checkpoint import CheckpointManager, ObjectStoreBackend
+    from deeplearning4j_tpu.checkpoint import sharded as shd
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    from deeplearning4j_tpu.parallel.elastic import LeaseBoard, Rendezvous
+
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((256, 64)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 256)]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater(Adam(0.01)).weight_init("xavier").list()
+            .layer(DenseLayer(n_out=256, activation="relu"))
+            .layer(OutputLayer(n_out=10, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(64))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(DataSet(x, y))
+
+    # --- sharded save + restore --------------------------------------
+    cm = CheckpointManager(storage=ObjectStoreBackend(), sharded=True)
+
+    def save_once():
+        t0 = time.perf_counter()
+        cm.save(net)  # sharded saves are synchronous (device_get inside)
+        np.asarray(jax.tree_util.tree_leaves(net.params)[0])
+        return time.perf_counter() - t0
+    save_ms = _best_of(save_once) * 1000.0
+
+    def restore_once():
+        t0 = time.perf_counter()
+        m = cm.restore_latest()
+        np.asarray(jax.tree_util.tree_leaves(m.params)[0])
+        return time.perf_counter() - t0
+    restore_ms = _best_of(restore_once) * 1000.0
+
+    # --- 4-host shard set -> 1-process world (reshard-on-restore) ----
+    payloads = [shd.shard_zip_bytes(s, {"batch_in_epoch": 0})
+                for s in shd.simulated_shard_snapshots(net, 4)]
+
+    def reshard_once():
+        t0 = time.perf_counter()
+        m, _ = shd.restore_from_payloads(payloads)
+        np.asarray(jax.tree_util.tree_leaves(m.params)[0])
+        return time.perf_counter() - t0
+    reshard_ms = _best_of(reshard_once) * 1000.0
+
+    # --- membership transition pause (storage-rendezvous half) -------
+    store = ObjectStoreBackend()
+    board = LeaseBoard(store, "w00", ttl_s=2.0, heartbeat_s=0.5)
+    rd = Rendezvous(store, board, join_timeout_s=30.0, poll_s=0.01)
+    rd.propose_or_await(1, expected=1)
+    gen = [1]
+
+    def transition_once():
+        t0 = time.perf_counter()
+        rd.request_bump(gen[0], "bench")
+        rd.propose_or_await(gen[0] + 1)
+        m = cm.restore_latest()
+        np.asarray(jax.tree_util.tree_leaves(m.params)[0])
+        gen[0] += 1
+        return time.perf_counter() - t0
+    transition_ms = _best_of(transition_once) * 1000.0
+
+    emit("elastic_sharded_save_ms", save_ms, "ms", "elastic",
+         restore_ms=round(restore_ms, 2),
+         note="one epoch-boundary sharded commit (snapshot + shard put + "
+              "journal) and its restore, in-process object store. "
+              + _REPS_NOTE)
+    emit("elastic_reshard_restore_ms", reshard_ms, "ms", "elastic",
+         num_shards=4,
+         note="reassemble a 4-host shard set into a 1-process world "
+              "(N->M reshard-on-restore) incl. model build + placement. "
+              + _REPS_NOTE)
+    emit("elastic_membership_transition_ms", transition_ms, "ms",
+         "elastic",
+         note="bump request -> next generation adopted -> checkpoint "
+              "restored (storage-rendezvous half of an elastic "
+              "transition; multi-process re-init cost rides on top). "
+              "metrics only — thresholds on quiet full runs per the 9p "
+              "note. " + _REPS_NOTE)
+
+
 def main():
     benches = [("lenet", bench_lenet), ("word2vec", bench_word2vec),
                ("charlstm", bench_graveslstm), ("serving", bench_serving),
                ("checkpoint", bench_checkpoint),
                ("resilience", bench_resilience),
+               ("elastic", bench_elastic),
                ("resnet50_fusion", bench_resnet50_fusion),
                ("resnet50", bench_resnet50)]
     only = os.environ.get("BENCH_ONLY")

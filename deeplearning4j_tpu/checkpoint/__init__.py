@@ -18,8 +18,15 @@ Five cooperating pieces (see each module's docstring):
                  restore_latest + fit under a restart budget, turning
                  preemption into a no-op for callers;
 - ``faults``   — the chaos harness: FaultInjector (step / epoch-boundary /
-                 probabilistic kills), FlakyBackend (seeded storage
-                 faults + latency), tear/flip corruption simulators.
+                 probabilistic kills, as exceptions or REAL SIGKILL),
+                 FlakyBackend (seeded storage faults + latency, aimable
+                 at name prefixes), tear/flip corruption simulators;
+- ``sharded``  — per-host shard files journaled as one set entry with
+                 per-shard sha256 and N→M reshard-on-restore
+                 (``CheckpointManager(sharded=True)``);
+- ``supervisor`` — ``train_until_process``: restart crashed/preempted
+                 training as NEW OS processes under the same
+                 RestartPolicy/CrashRecord semantics as ``train_until``.
 
 Wired end-to-end as ``fit(..., checkpoint_manager=cm)`` on
 MultiLayerNetwork, ComputationGraph, ParallelWrapper and ClusterTrainer;
@@ -64,4 +71,18 @@ from deeplearning4j_tpu.checkpoint.resume import (  # noqa: F401
     RestartPolicy,
     RunSummary,
     train_until,
+)
+from deeplearning4j_tpu.checkpoint.sharded import (  # noqa: F401
+    ShardedCheckpointError,
+    restore_sharded,
+    scan_shard_sets,
+    shard_snapshot,
+    simulated_shard_snapshots,
+    state_sha,
+)
+from deeplearning4j_tpu.checkpoint.supervisor import (  # noqa: F401
+    ELASTIC_RESTART_EXIT,
+    ProcessCrashRecord,
+    ProcessRunSummary,
+    train_until_process,
 )

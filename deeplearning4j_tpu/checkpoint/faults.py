@@ -69,12 +69,23 @@ class FaultInjector:
     ``max_kills`` bounds the total crashes one injector fires (default 1:
     a listener that keeps killing a resumed run would turn ``train_until``
     into a restart-budget test); raise it to simulate repeated preemption.
+
+    ``kill_mode`` selects HOW the injector kills:
+
+    - ``"exception"`` (default): raise :class:`SimulatedCrash` — the
+      in-process crash ``train_until``'s restore/refit loop recovers;
+    - ``"process"``: ``SIGKILL`` the current process — REAL process death
+      (no cleanup, no atexit, no flushing), the preemption shape the
+      process supervisor (checkpoint/supervisor.py) and the elastic layer
+      (parallel/elastic.py) must survive. Only meaningful in a worker
+      subprocess a supervisor watches.
     """
 
     def __init__(self, kill_at_step: Optional[int] = None,
                  kill_at_epoch: Optional[int] = None,
                  kill_probability: Optional[float] = None,
-                 seed: int = 0, max_kills: int = 1):
+                 seed: int = 0, max_kills: int = 1,
+                 kill_mode: str = "exception"):
         if kill_at_step is None and kill_at_epoch is None \
                 and kill_probability is None:
             raise ValueError("need kill_at_step, kill_at_epoch or "
@@ -86,6 +97,9 @@ class FaultInjector:
         if kill_probability is not None \
                 and not 0.0 < kill_probability <= 1.0:
             raise ValueError("kill_probability must be in (0, 1]")
+        if kill_mode not in ("exception", "process"):
+            raise ValueError("kill_mode must be 'exception' or 'process'")
+        self.kill_mode = kill_mode
         self.kill_at_step = None if kill_at_step is None else int(kill_at_step)
         self.kill_at_epoch = (None if kill_at_epoch is None
                               else int(kill_at_epoch))
@@ -98,6 +112,11 @@ class FaultInjector:
     def _kill(self, why: str):
         self.fired = True
         self.kills += 1
+        if self.kill_mode == "process":
+            # REAL death: no exception anyone could catch, no cleanup —
+            # exactly what a preemption does to a worker
+            import signal
+            os.kill(os.getpid(), signal.SIGKILL)
         raise SimulatedCrash(f"fault injection: {why}")
 
     def _armed(self) -> bool:
@@ -145,16 +164,21 @@ class FlakyBackend(StorageBackend):
       write the per-op timeout in ``RetryingBackend`` must bound.
 
     ``ops`` restricts which operations can fault (default: all mutating +
-    reading ops). Counters (``calls``, ``faults_injected``) let tests
-    assert the chaos actually happened — a chaos test whose injector
-    never fired proves nothing.
+    reading ops). ``match`` restricts faults to object NAMES with that
+    prefix (for ``list``, the listing prefix) — how chaos is aimed at the
+    elastic membership path specifically: ``match="lease-"`` faults only
+    the lease heartbeats, ``match="gen-"`` only the membership records,
+    while checkpoints riding the same backend stay healthy. Counters
+    (``calls``, ``faults_injected``) let tests assert the chaos actually
+    happened — a chaos test whose injector never fired proves nothing.
     """
 
     _ALL_OPS = ("put", "get", "list", "delete", "exists")
 
     def __init__(self, inner: StorageBackend, seed: int = 0,
                  transient_rate: float = 0.0, put_latency_s: float = 0.0,
-                 ops=("put", "get", "list", "delete")):
+                 ops=("put", "get", "list", "delete"),
+                 match: Optional[str] = None):
         if not 0.0 <= transient_rate < 1.0:
             raise ValueError("transient_rate must be in [0, 1)")
         unknown = set(ops) - set(FlakyBackend._ALL_OPS)
@@ -164,6 +188,7 @@ class FlakyBackend(StorageBackend):
         self.transient_rate = float(transient_rate)
         self.put_latency_s = float(put_latency_s)
         self.ops = tuple(ops)
+        self.match = match
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._scripted: List[BaseException] = []
@@ -180,8 +205,11 @@ class FlakyBackend(StorageBackend):
                     error if error is not None else TransientStorageError(
                         "scripted transient storage fault"))
 
-    def _maybe_fail(self, op: str):
+    def _maybe_fail(self, op: str, name: Optional[str] = None):
         if op not in self.ops:
+            return
+        if self.match is not None and \
+                (name is None or not name.startswith(self.match)):
             return
         with self._lock:
             self.calls += 1
@@ -196,25 +224,25 @@ class FlakyBackend(StorageBackend):
                     f"(rate={self.transient_rate})")
 
     def put(self, name: str, data: bytes, fsync_directory: bool = True):
-        self._maybe_fail("put")
+        self._maybe_fail("put", name)
         if self.put_latency_s:
             time.sleep(self.put_latency_s)
         return self.inner.put(name, data, fsync_directory=fsync_directory)
 
     def get(self, name: str) -> bytes:
-        self._maybe_fail("get")
+        self._maybe_fail("get", name)
         return self.inner.get(name)
 
     def list(self, prefix: str = "") -> List[str]:
-        self._maybe_fail("list")
+        self._maybe_fail("list", prefix)
         return self.inner.list(prefix)
 
     def delete(self, name: str):
-        self._maybe_fail("delete")
+        self._maybe_fail("delete", name)
         return self.inner.delete(name)
 
     def exists(self, name: str) -> bool:
-        self._maybe_fail("exists")
+        self._maybe_fail("exists", name)
         return self.inner.exists(name)
 
     def clean_orphans(self):

@@ -37,9 +37,13 @@ resumable training:
 - **Multi-host.** Only process 0 writes; every ``save`` point is a
   collective barrier bounded by a ``CollectiveWatchdog`` deadline, so a
   dead peer surfaces as a diagnostic timeout instead of a silent hang.
-  (Params must be process-0 addressable — replicated or single-host
-  sharded; multi-host tensor-parallel checkpointing would need a gather
-  and is out of scope here.)
+  The default (whole-zip) format needs params process-0 addressable;
+  ``sharded=True`` removes that restriction: every host writes its OWN
+  shard of the state (checkpoint/sharded.py), the set is journaled as one
+  manifest entry (per-shard sha256) only after every shard is durable,
+  and restore reassembles the full state on any world size — a 4-worker
+  checkpoint restores into a 3-worker (or 1-worker) job, the N→M
+  reshard-on-restore the elastic layer (parallel/elastic.py) builds on.
 
 The manager also implements the early-stopping saver protocol
 (``save_best_model`` / ``save_latest_model`` / ``get_best_model``), so it
@@ -110,7 +114,8 @@ class CheckpointManager:
                  queue_depth: int = 2,
                  barrier_timeout_s: float = 300.0,
                  save_updater: bool = True,
-                 storage=None):
+                 storage=None,
+                 sharded: bool = False):
         if save_every_n_steps is not None and save_every_n_steps < 1:
             raise ValueError("save_every_n_steps must be >= 1")
         if keep_best not in (None, "min", "max"):
@@ -127,6 +132,17 @@ class CheckpointManager:
         self.async_write = bool(async_write)
         self.barrier_timeout_s = float(barrier_timeout_s)
         self.save_updater = bool(save_updater)
+        # sharded=True: every host writes its own shard (per-host shard
+        # files + one set entry in the journal); sharded saves are always
+        # synchronous — they end in a cross-host barrier anyway, and the
+        # elastic layer only saves at epoch boundaries
+        self.sharded = bool(sharded)
+        # optional fencing hook run immediately before a journal commit;
+        # raising aborts the commit (payloads stay orphaned, never
+        # journaled). The elastic layer points this at its membership
+        # generation check so a stale, evicted leader cannot journal a
+        # checkpoint behind the live generation's back.
+        self.commit_guard: Optional[callable] = None
         from deeplearning4j_tpu.checkpoint import manifest as mf
         from deeplearning4j_tpu.checkpoint.storage import LocalFSBackend
         self._mf = mf
@@ -145,18 +161,27 @@ class CheckpointManager:
         except mf.ManifestError as e:
             log.warning("%s — rebuilding from storage scan", e)
             entries = None
-        if entries is None and mf.scan_checkpoint_files(self._storage):
-            # torn OR missing manifest over surviving checkpoint files:
-            # rebuild the journal — sha recomputed AND the per-entry
-            # metadata (step/metric/...) read back out of each zip, so
-            # restore_best / retention / checkpoints() keep working after
-            # the rebuild, not just restore_latest
-            entries = []
-            for e_ in mf.scan_checkpoint_files(self._storage):
-                rebuilt = self._entry_from_object(e_["file"])
-                if rebuilt is not None:
-                    entries.append(rebuilt)
-            mf.write_manifest(self._storage, entries)
+        if entries is None:
+            from deeplearning4j_tpu.checkpoint import sharded as shd
+            rebuilt_sharded = shd.scan_shard_sets(self._storage)
+            if mf.scan_checkpoint_files(self._storage) or rebuilt_sharded:
+                # torn OR missing manifest over surviving checkpoint
+                # files: rebuild the journal — sha recomputed AND the
+                # per-entry metadata (step/metric/...) read back out of
+                # each zip, so restore_best / retention / checkpoints()
+                # keep working after the rebuild, not just
+                # restore_latest. Complete shard SETS rebuild as sharded
+                # entries; incomplete sets (crash between shard puts and
+                # the journal write) are skipped like tmp/ orphans.
+                entries = []
+                for e_ in mf.scan_checkpoint_files(self._storage):
+                    rebuilt = self._entry_from_object(e_["file"])
+                    if rebuilt is not None:
+                        entries.append(rebuilt)
+                entries.extend(rebuilt_sharded)
+                entries.sort(key=lambda e: (int(e.get("step", 0)),
+                                            int(e.get("seq", 0))))
+                mf.write_manifest(self._storage, entries)
         self._entries: List[dict] = entries or []
         self._seq = max((int(e.get("seq", 0)) for e in self._entries),
                         default=0)
@@ -187,6 +212,9 @@ class CheckpointManager:
             sha = _hashlib.sha256(data).hexdigest()
             with zipfile.ZipFile(io.BytesIO(data), "r") as z:
                 meta = json.loads(z.read("metadata.json"))
+            if meta.get("shard"):
+                return None  # a per-host shard, not a whole checkpoint —
+                # scan_shard_sets rebuilds these as one set entry
             return {
                 "file": filename,
                 "seq": int(meta.get("seq", 0)),
@@ -282,6 +310,8 @@ class CheckpointManager:
         # counter on every host.
         self._last_save_t = time.monotonic()
         self._last_save_step = int(model.iteration)
+        if self.sharded:
+            return self._save_sharded(model, metric)
         multi = jax.process_count() > 1
         if multi and jax.process_index() != 0:
             # non-writers only barrier: keeps every host's save points in
@@ -359,6 +389,81 @@ class CheckpointManager:
             finally:
                 self._q.task_done()
 
+    # ---------------------------------------------------------- sharded save
+    def _save_sharded(self, model, metric: Optional[float]) -> Optional[str]:
+        """Every host writes its OWN shard; the set becomes one journal
+        entry (per-shard sha256) committed by process 0 only after a
+        barrier proves every shard durable — the commit of the SET is
+        atomic: a crash anywhere before the journal write leaves orphaned
+        shards the restore walk never sees. Always synchronous (the save
+        ends in a cross-host barrier regardless, and the elastic layer
+        saves at epoch boundaries, not on the step cadence)."""
+        import jax
+        from deeplearning4j_tpu.checkpoint import sharded as shd
+        pi, pc = jax.process_index(), jax.process_count()
+        self._seq += 1  # every host: shard names must agree fleet-wide
+        snap = shd.shard_snapshot(model)
+        if not self.save_updater:
+            snap["updaterState"] = None
+        extra = {
+            "seq": self._seq,
+            "batch_in_epoch": self._batch_in_epoch,
+            "wall_time": time.time(),
+            "metric": None if metric is None else float(metric),
+        }
+        base = f"ckpt-{snap['iteration']:010d}-{self._seq:05d}"
+        shard_name = shd.shard_object_name(base, pi, pc)
+        self.saves_requested += 1
+        self._storage.put(shard_name, shd.shard_zip_bytes(snap, extra))
+        self._barrier("sharded payloads durable")
+        if pi == 0:
+            shards = []
+            for host in range(pc):
+                name = shd.shard_object_name(base, host, pc)
+                data = self._storage.get(name)  # read-back doubles as a
+                shards.append({  # read-your-writes durability probe
+                    "file": name,
+                    "sha256": hashlib.sha256(data).hexdigest(),
+                    "size": len(data),
+                })
+            entry = {
+                "file": f"{base}.sharded",
+                "sharded": True,
+                "num_hosts": pc,
+                "shards": shards,
+                "seq": extra["seq"],
+                "step": snap["iteration"],
+                "epoch": snap["epoch"],
+                "batch_in_epoch": extra["batch_in_epoch"],
+                "metric": extra["metric"],
+                "wall_time": extra["wall_time"],
+                "sha256": None,
+                "size": sum(s["size"] for s in shards),
+            }
+            try:
+                if self.commit_guard is not None:
+                    self.commit_guard()  # raising aborts the commit
+                with self._lock:
+                    self._entries.append(entry)
+                    self._entries = self._apply_retention(self._entries)
+                    self._mf.write_manifest(self._storage, self._entries)
+            except BaseException:
+                # the un-journaled shard set must not survive: it is a
+                # COMPLETE set, and a later manifest-loss rebuild
+                # (scan_shard_sets) would resurrect the very checkpoint
+                # the fence refused to commit
+                for s in shards:
+                    try:
+                        self._storage.delete(s["file"])
+                    except Exception as de:
+                        log.warning("could not delete aborted shard %s "
+                                    "(%s: %s)", s["file"],
+                                    type(de).__name__, de)
+                raise
+            self.saves_committed += 1
+        self._barrier("sharded journal")
+        return f"{base}.sharded" if pi == 0 else None
+
     def _write_and_commit(self, snap: dict, extra: dict, filename: str):
         from deeplearning4j_tpu.utils.serialization import checkpoint_zip_bytes
         data = checkpoint_zip_bytes(snap, extra)
@@ -378,10 +483,23 @@ class CheckpointManager:
             "sha256": sha,
             "size": len(data),
         }
-        with self._lock:
-            self._entries.append(entry)
-            self._entries = self._apply_retention(self._entries)
-            self._mf.write_manifest(self._storage, self._entries)
+        try:
+            if self.commit_guard is not None:
+                self.commit_guard()  # raising aborts the journal commit
+            with self._lock:
+                self._entries.append(entry)
+                self._entries = self._apply_retention(self._entries)
+                self._mf.write_manifest(self._storage, self._entries)
+        except BaseException:
+            # the un-journaled payload must not survive a guard abort: a
+            # later manifest-loss scan would resurrect the very
+            # checkpoint the generation fence refused to commit
+            try:
+                self._storage.delete(filename)
+            except Exception as de:
+                log.warning("could not delete aborted checkpoint %s "
+                            "(%s: %s)", filename, type(de).__name__, de)
+            raise
         self.saves_committed += 1
 
     def _best_entry(self, entries: List[dict],
@@ -406,12 +524,17 @@ class CheckpointManager:
             (kept if id(e) in keep else pruned).append(e)
         from deeplearning4j_tpu.checkpoint.storage import StorageError
         for e in pruned:
-            try:
-                self._storage.delete(e["file"])
-            except (OSError, StorageError) as err:
-                # retention is best-effort; the manifest is truth
-                log.warning("retention could not delete %s (%s: %s)",
-                            e["file"], type(err).__name__, err)
+            # a sharded entry's payload is its shard SET; the entry's own
+            # "file" is a virtual name with no object behind it
+            names = ([s["file"] for s in e["shards"]] if e.get("sharded")
+                     else [e["file"]])
+            for name in names:
+                try:
+                    self._storage.delete(name)
+                except (OSError, StorageError) as err:
+                    # retention is best-effort; the manifest is truth
+                    log.warning("retention could not delete %s (%s: %s)",
+                                name, type(err).__name__, err)
         return kept
 
     # ---------------------------------------------------------------- control
@@ -495,14 +618,25 @@ class CheckpointManager:
     def _try_restore(self, entry: dict, load_updater: bool,
                      arm_resume: bool):
         import io
-        data = self._storage.get(entry["file"])  # StorageNotFoundError if gone
-        if entry.get("sha256") is not None and \
-                hashlib.sha256(data).hexdigest() != entry["sha256"]:
-            raise CheckpointError(
-                f"checksum mismatch for {entry['file']} (torn/corrupt write)")
-        from deeplearning4j_tpu.utils.serialization import restore_checkpoint
-        model, meta = restore_checkpoint(io.BytesIO(data),
-                                         load_updater=load_updater)
+        if entry.get("sharded"):
+            # shard-set entry: fetch + sha-verify every shard, reassemble
+            # the full state (works on ANY restoring world size — the N→M
+            # reshard-on-restore path). Any failure raises and the walk
+            # falls back a whole generation; shard sets never mix.
+            from deeplearning4j_tpu.checkpoint import sharded as shd
+            model, meta = shd.restore_sharded(self._storage, entry,
+                                              load_updater=load_updater)
+        else:
+            data = self._storage.get(entry["file"])  # StorageNotFoundError
+            if entry.get("sha256") is not None and \
+                    hashlib.sha256(data).hexdigest() != entry["sha256"]:
+                raise CheckpointError(
+                    f"checksum mismatch for {entry['file']} "
+                    f"(torn/corrupt write)")
+            from deeplearning4j_tpu.utils.serialization import (
+                restore_checkpoint)
+            model, meta = restore_checkpoint(io.BytesIO(data),
+                                             load_updater=load_updater)
         path = (os.path.join(self.directory, entry["file"])
                 if self.directory is not None
                 else f"{self._storage.describe()}/{entry['file']}")
@@ -558,6 +692,21 @@ class CheckpointManager:
                 log.warning("checkpoint %s unusable (%s: %s); falling back",
                             entry.get("file"), type(e).__name__, e)
         return None
+
+    def restore_entry(self, filename: str, load_updater: bool = True):
+        """Restore one SPECIFIC committed checkpoint by its journal
+        ``file`` name (sharded set entries use their virtual
+        ``*.sharded`` name). Model selection like ``restore_best`` — no
+        resume marker is armed. Raises :class:`CheckpointError` when the
+        journal has no such entry; integrity failures propagate (no
+        fallback — the caller asked for exactly this checkpoint)."""
+        if self._worker is not None and self._worker.is_alive():
+            self.flush()
+        for entry in self._restorable_entries():
+            if entry.get("file") == filename:
+                return self._try_restore(entry, load_updater,
+                                         arm_resume=False)
+        raise CheckpointError(f"no journal entry named {filename!r}")
 
     # ------------------------------------------------------------- multi-host
     def _barrier(self, what: str):

@@ -1,0 +1,427 @@
+"""Multi-host sharded checkpoints with N→M reshard-on-restore.
+
+The PR-2/PR-5 checkpoint format requires params to be process-0
+addressable: ``snapshot_training_state`` runs ``jax.device_get`` over the
+whole tree, which on a multi-host tensor-parallel (or optimizer-sharded)
+job would try to fetch remote shards and fail. This module removes that
+restriction: every host snapshots only the blocks it OWNS, writes them as
+its own shard object, and the manifest journals the shard *set* as one
+first-class entry (per-shard sha256, committed only after every shard is
+durable — see ``CheckpointManager._save_sharded``).
+
+Ownership is derived from the array's real sharding: for each distinct
+index block of ``sharding.devices_indices_map``, the device with the
+smallest id is the owner, and a host writes the block iff that owner is
+local. Replicated arrays therefore land in host 0's shard once; sharded
+arrays land as exactly one copy of each block, wherever it lives. Plain
+host arrays (numpy) belong to host 0.
+
+Restore is the reverse: fetch every shard named by the manifest entry,
+verify each against its journaled sha256, reassemble full host arrays
+from the blocks, and build the model exactly like
+``utils.serialization.restore_checkpoint``. Because assembly produces the
+FULL global state on the host, the restoring world does not need to match
+the writing world: a checkpoint written by 4 workers restores into 3 (or
+1) — params/opt-state are reassembled identically and the new world's
+trainer re-places them over its own mesh. That is the N→M
+reshard-on-restore the elastic layer (parallel/elastic.py) leans on when
+membership changes. (The cost: full state must fit host RAM during
+restore; a streaming reshard is future work.)
+
+Shard objects are named ``shard-<base>.d<k>of<M>.zip`` — a prefix the
+manifest's ``scan_checkpoint_files`` (``ckpt-*``) never matches, so torn
+manifest recovery cannot mistake a shard for a whole checkpoint;
+:func:`scan_shard_sets` rebuilds sharded entries from *complete* sets
+only (an incomplete set — a crash between shard puts and the journal
+write — is ignored, exactly like a tmp/ orphan).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import logging
+import re
+import zipfile
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+SHARD_PREFIX = "shard-"
+SHARD_FORMAT_VERSION = 1
+_SHARD_RE = re.compile(
+    r"^shard-(ckpt-(\d{10})-(\d{5}))\.d(\d{3})of(\d{3})\.zip$")
+
+__all__ = [
+    "ShardedCheckpointError", "shard_snapshot", "simulated_shard_snapshots",
+    "shard_zip_bytes", "shard_object_name", "restore_from_payloads",
+    "restore_sharded", "scan_shard_sets", "state_sha", "SHARD_PREFIX",
+]
+
+
+class ShardedCheckpointError(RuntimeError):
+    """A shard set is unusable: missing/corrupt shard, incomplete block
+    coverage, or shards from mismatched checkpoints. The manager's restore
+    walk treats it like any torn checkpoint — fall back a generation,
+    never assemble a mixed or partial state."""
+
+
+# ------------------------------------------------------------- block slicing
+def _norm_index(index, shape) -> Tuple[Tuple[int, int], ...]:
+    """Normalize a devices_indices_map index (tuple of slices) to
+    ((start, stop), ...) pairs; scalars normalize to ()."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        if sl.step not in (None, 1):
+            raise ShardedCheckpointError(
+                f"non-unit-stride shard index {index} is not supported")
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _leaf_blocks(arr) -> List[dict]:
+    """The blocks of ``arr`` THIS host owns. Owner of an index block = the
+    participating device with the smallest id; a replicated array is owned
+    entirely by the sharding's first device's host."""
+    import jax
+    if not isinstance(arr, jax.Array):
+        # plain host array (or python scalar): host 0 owns it whole
+        a = np.asarray(arr)
+        if jax.process_index() != 0:
+            return []
+        return [{"index": tuple((0, d) for d in a.shape), "data": a}]
+    shape = arr.shape
+    owner: Dict[tuple, int] = {}
+    for dev, idx in arr.sharding.devices_indices_map(shape).items():
+        key = _norm_index(idx, shape)
+        if key not in owner or dev.id < owner[key]:
+            owner[key] = dev.id
+    blocks = []
+    for shard in arr.addressable_shards:
+        key = _norm_index(shard.index, shape)
+        if owner.get(key) == shard.device.id:
+            blocks.append({"index": key, "data": np.asarray(shard.data)})
+    return blocks
+
+
+def _tree_blocks(tree) -> List[dict]:
+    """Owned blocks for every leaf of ``tree``, keyed like the
+    ``coefficients.npz`` layout (utils.serialization path keys)."""
+    import jax
+    from deeplearning4j_tpu.utils.serialization import _path_key
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        leaf_key = _path_key(path)
+        gshape = tuple(np.shape(leaf))
+        dtype = str(np.asarray(leaf).dtype) if not hasattr(leaf, "dtype") \
+            else str(leaf.dtype)
+        for b in _leaf_blocks(leaf):
+            out.append({"leaf": leaf_key, "shape": list(gshape),
+                        "dtype": dtype, "index": b["index"],
+                        "data": b["data"]})
+    return out
+
+
+# ---------------------------------------------------------------- snapshots
+def shard_snapshot(model) -> dict:
+    """This host's shard of everything exact-step resume needs. Block data
+    is copied to host memory on the calling thread (same donation-safety
+    discipline as ``snapshot_training_state``); the RNG key and counters —
+    replicated by construction — ride in host 0's shard only."""
+    import jax
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    if model.params is None:
+        model.init()
+    if isinstance(model, MultiLayerNetwork):
+        model_type = "MultiLayerNetwork"
+    elif isinstance(model, ComputationGraph):
+        model_type = "ComputationGraph"
+    else:
+        raise TypeError(f"Cannot checkpoint {type(model)}")
+    host = jax.process_index()
+    rng = model._rng
+    return {
+        "model_type": model_type,
+        "conf_json": model.conf.to_json(),
+        "iteration": int(model.iteration),
+        "epoch": int(model.epoch),
+        "host": host,
+        "num_hosts": jax.process_count(),
+        "coefficients": _tree_blocks([model.params, model.state]),
+        "updaterState": (None if model.opt_state is None
+                         else _tree_blocks(model.opt_state)),
+        "rng": (None if (rng is None or host != 0)
+                else np.asarray(jax.random.key_data(rng))),
+    }
+
+
+def simulated_shard_snapshots(model, num_hosts: int) -> List[dict]:
+    """``num_hosts`` synthetic host shards of a single-process model —
+    each leaf row-partitioned into contiguous chunks (leaves too small to
+    split belong to host 0). Lets single-process tests and benches
+    exercise the exact multi-shard assemble/restore path a real N-host
+    job produces."""
+    import jax
+    from deeplearning4j_tpu.utils.serialization import _path_key
+
+    def split(tree, host):
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        out = []
+        for path, leaf in flat:
+            a = np.asarray(jax.device_get(leaf))
+            gshape = tuple(a.shape)
+            if a.ndim >= 1 and a.shape[0] >= num_hosts:
+                bounds = np.linspace(0, a.shape[0], num_hosts + 1).astype(int)
+                lo, hi = int(bounds[host]), int(bounds[host + 1])
+                if lo == hi:
+                    continue
+                index = ((lo, hi),) + tuple((0, d) for d in a.shape[1:])
+                data = a[lo:hi]
+            elif host == 0:
+                index = tuple((0, d) for d in gshape)
+                data = a
+            else:
+                continue
+            out.append({"leaf": _path_key(path), "shape": list(gshape),
+                        "dtype": str(a.dtype), "index": index, "data": data})
+        return out
+
+    base = shard_snapshot(model)
+    snaps = []
+    for host in range(num_hosts):
+        snaps.append({
+            **{k: base[k] for k in ("model_type", "conf_json", "iteration",
+                                    "epoch")},
+            "host": host,
+            "num_hosts": num_hosts,
+            "coefficients": split([model.params, model.state], host),
+            "updaterState": (None if model.opt_state is None
+                             else split(model.opt_state, host)),
+            "rng": base["rng"] if host == 0 else None,
+        })
+    return snaps
+
+
+# ------------------------------------------------------------------- format
+def shard_object_name(base: str, host: int, num_hosts: int) -> str:
+    return f"{SHARD_PREFIX}{base}.d{host:03d}of{num_hosts:03d}.zip"
+
+
+def shard_zip_bytes(snap: dict, extra_meta: Optional[dict] = None) -> bytes:
+    """One host shard as zip bytes (ZIP_STORED, same rationale as
+    ``checkpoint_zip_bytes``): metadata + config + a block index + the
+    block arrays, plus the RNG key on host 0."""
+    meta = {
+        "format_version": SHARD_FORMAT_VERSION,
+        "shard": True,  # manifest rebuild must never mistake this for a
+        "model_type": snap["model_type"],  # whole checkpoint
+        "iteration": snap["iteration"],
+        "epoch": snap["epoch"],
+        "host": snap["host"],
+        "num_hosts": snap["num_hosts"],
+        "has_updater": snap["updaterState"] is not None,
+        "has_rng": snap["rng"] is not None,
+    }
+    meta.update(extra_meta or {})
+    index, arrays = [], {}
+    for tree in ("coefficients", "updaterState"):
+        for i, b in enumerate(snap[tree] or []):
+            key = f"{tree[0]}{i}"
+            index.append({"key": key, "tree": tree, "leaf": b["leaf"],
+                          "shape": b["shape"], "dtype": b["dtype"],
+                          "index": [list(p) for p in b["index"]]})
+            arrays[key] = b["data"]
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_STORED) as z:
+        z.writestr("metadata.json", json.dumps(meta))
+        z.writestr("configuration.json", snap["conf_json"])
+        z.writestr("blockindex.json", json.dumps(index))
+        bbuf = io.BytesIO()
+        np.savez(bbuf, **arrays)
+        z.writestr("blocks.npz", bbuf.getvalue())
+        if snap["rng"] is not None:
+            rbuf = io.BytesIO()
+            np.savez(rbuf, key_data=snap["rng"])
+            z.writestr("rngState.npz", rbuf.getvalue())
+    return buf.getvalue()
+
+
+def _parse_shard(data: bytes) -> dict:
+    try:
+        with zipfile.ZipFile(io.BytesIO(data), "r") as z:
+            meta = json.loads(z.read("metadata.json"))
+            conf_json = z.read("configuration.json").decode()
+            index = json.loads(z.read("blockindex.json"))
+            blocks = dict(np.load(io.BytesIO(z.read("blocks.npz"))))
+            rng = None
+            if meta.get("has_rng") and "rngState.npz" in z.namelist():
+                rng = dict(np.load(io.BytesIO(
+                    z.read("rngState.npz"))))["key_data"]
+    except (zipfile.BadZipFile, KeyError, ValueError, OSError) as e:
+        raise ShardedCheckpointError(
+            f"unreadable shard ({type(e).__name__}: {e})") from e
+    return {"meta": meta, "conf_json": conf_json, "index": index,
+            "blocks": blocks, "rng": rng}
+
+
+# ----------------------------------------------------------------- assembly
+def _assemble(parsed: List[dict], tree: str) -> Dict[str, np.ndarray]:
+    """Full host arrays from every shard's blocks of ``tree``. Coverage is
+    enforced: duplicated (leaf, index) blocks and block element counts
+    that do not sum to the leaf's size both raise — a partial or doubled
+    assembly must never restore silently."""
+    leaves: Dict[str, dict] = {}
+    seen = set()
+    for p in parsed:
+        for ent in p["index"]:
+            if ent["tree"] != tree:
+                continue
+            key = (ent["leaf"], tuple(tuple(x) for x in ent["index"]))
+            if key in seen:
+                raise ShardedCheckpointError(
+                    f"duplicate block for leaf '{ent['leaf']}' at "
+                    f"{ent['index']} across shards")
+            seen.add(key)
+            data = p["blocks"][ent["key"]]
+            info = leaves.setdefault(ent["leaf"], {
+                "shape": tuple(ent["shape"]),
+                "array": np.empty(tuple(ent["shape"]),
+                                  dtype=np.dtype(ent["dtype"])),
+                "filled": 0,
+            })
+            sl = tuple(slice(a, b) for a, b in ent["index"])
+            info["array"][sl] = data
+            info["filled"] += int(np.prod(data.shape, dtype=np.int64))
+    out = {}
+    for leaf, info in leaves.items():
+        want = int(np.prod(info["shape"], dtype=np.int64))
+        if info["filled"] != want:
+            raise ShardedCheckpointError(
+                f"incomplete coverage for leaf '{leaf}': {info['filled']} "
+                f"of {want} elements present — missing or torn shard")
+        out[leaf] = info["array"]
+    return out
+
+
+def restore_from_payloads(payloads: List[bytes], load_updater: bool = True):
+    """(model, meta) from a complete list of shard payload bytes. Every
+    shard must agree on (model_type, iteration, epoch, num_hosts) and the
+    list must hold exactly ``num_hosts`` shards — shards from different
+    checkpoint generations can never silently mix."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.conf.graph import ComputationGraphConfiguration
+    from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.utils.serialization import _restore_into
+    parsed = [_parse_shard(d) for d in payloads]
+    idents = {(p["meta"]["model_type"], p["meta"]["iteration"],
+               p["meta"]["epoch"], p["meta"]["num_hosts"]) for p in parsed}
+    if len(idents) != 1:
+        raise ShardedCheckpointError(
+            f"shards disagree on checkpoint identity: {sorted(idents)} — "
+            "refusing to mix generations")
+    num_hosts = int(parsed[0]["meta"]["num_hosts"])
+    hosts = sorted(int(p["meta"].get("host", -1)) for p in parsed)
+    if hosts != list(range(num_hosts)):
+        raise ShardedCheckpointError(
+            f"shard set holds hosts {hosts} but the checkpoint was "
+            f"written by hosts 0..{num_hosts - 1} — missing or "
+            "duplicated shard")
+    meta_p = next(p for p in parsed if p["meta"].get("host") == 0)
+    meta = meta_p["meta"]
+    conf_json = meta_p["conf_json"]
+    if meta["model_type"] == "MultiLayerNetwork":
+        model = MultiLayerNetwork(MultiLayerConfiguration.from_json(conf_json))
+    else:
+        model = ComputationGraph(
+            ComputationGraphConfiguration.from_json(conf_json))
+    model.init()
+    coeff = _assemble(parsed, "coefficients")
+    model.params, model.state = _restore_into(
+        [model.params, model.state], coeff)
+    if load_updater and meta.get("has_updater"):
+        upd = _assemble(parsed, "updaterState")
+        model.opt_state = _restore_into(model.opt_state, upd)
+    if meta_p["rng"] is not None:
+        model._rng = jax.random.wrap_key_data(jnp.asarray(meta_p["rng"]))
+    model.iteration = int(meta.get("iteration", 0))
+    model.epoch = int(meta.get("epoch", 0))
+    return model, meta
+
+
+def restore_sharded(storage, entry: dict, load_updater: bool = True):
+    """(model, meta) for a manifest shard-set entry: fetch every shard,
+    verify each against its journaled sha256 (when present), reassemble.
+    Any failure raises — the manager's restore walk falls back one whole
+    generation rather than ever mixing shard sets."""
+    payloads = []
+    for s in entry.get("shards", []):
+        data = storage.get(s["file"])  # StorageNotFoundError if gone
+        if s.get("sha256") is not None and \
+                hashlib.sha256(data).hexdigest() != s["sha256"]:
+            raise ShardedCheckpointError(
+                f"checksum mismatch for shard {s['file']} (torn/corrupt)")
+        payloads.append(data)
+    return restore_from_payloads(payloads, load_updater=load_updater)
+
+
+def scan_shard_sets(storage) -> List[dict]:
+    """Degraded-mode recovery (manifest lost/torn): rebuild shard-set
+    entries from COMPLETE sets present in storage, in (step, seq) order.
+    Incomplete sets — a crash landed between shard puts and the journal
+    write — are skipped, like tmp/ orphans; per-shard zip metadata still
+    gates restore via :func:`restore_from_payloads`'s identity checks."""
+    groups: Dict[str, dict] = {}
+    for name in storage.list(prefix=SHARD_PREFIX):
+        m = _SHARD_RE.match(name)
+        if not m:
+            continue
+        base, step, seq, host, num = (m.group(1), int(m.group(2)),
+                                      int(m.group(3)), int(m.group(4)),
+                                      int(m.group(5)))
+        g = groups.setdefault(base, {"step": step, "seq": seq,
+                                     "num_hosts": num, "files": {}})
+        g["files"][host] = name
+    entries = []
+    for base, g in groups.items():
+        if set(g["files"]) != set(range(g["num_hosts"])):
+            log.warning("ignoring incomplete shard set %s (%d of %d shards "
+                        "present)", base, len(g["files"]), g["num_hosts"])
+            continue
+        entries.append({
+            "file": f"{base}.sharded",
+            "sharded": True,
+            "num_hosts": g["num_hosts"],
+            "shards": [{"file": g["files"][h], "sha256": None}
+                       for h in range(g["num_hosts"])],
+            "step": g["step"],
+            "seq": g["seq"],
+            "sha256": None,
+        })
+    entries.sort(key=lambda e: (e["step"], e["seq"]))
+    return entries
+
+
+# ---------------------------------------------------------------- utilities
+def state_sha(model) -> str:
+    """Deterministic digest over params + layer state + opt-state (leaf
+    order, shapes, dtypes and bytes) — the cross-world equality probe the
+    elastic tests use: a checkpoint restored into ANY world size must
+    produce the same digest."""
+    import jax
+    h = hashlib.sha256()
+    for tree in (model.params, model.state, model.opt_state):
+        for leaf in jax.tree_util.tree_leaves(tree):
+            a = np.ascontiguousarray(np.asarray(jax.device_get(leaf)))
+            h.update(str((a.shape, str(a.dtype))).encode())
+            h.update(a.tobytes())
+    return h.hexdigest()

@@ -7,10 +7,25 @@ from deeplearning4j_tpu.parallel.trainer import (  # noqa: F401
 from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401
 from deeplearning4j_tpu.parallel.sharding import (  # noqa: F401
     ShardIterator,
+    UnequalShardError,
+    check_equal_local_shards,
     shard_dataset_rows,
     shard_directory,
     shard_files,
     shard_iterator,
+)
+from deeplearning4j_tpu.parallel.elastic import (  # noqa: F401
+    ElasticError,
+    ElasticRestartRequired,
+    ElasticRunSummary,
+    ElasticRuntime,
+    ElasticWorker,
+    GenerationRecord,
+    LeaseBoard,
+    Membership,
+    Rendezvous,
+    RendezvousTimeout,
+    StaleGenerationError,
 )
 from deeplearning4j_tpu.parallel.stats import TrainingStats  # noqa: F401
 from deeplearning4j_tpu.parallel.watchdog import (  # noqa: F401

@@ -1,0 +1,941 @@
+"""Elastic cluster training: epoch-boundary membership changes survive
+worker death, arrival and whole-job preemption.
+
+The reference's cluster story keeps training through executor loss (Spark
+training masters + the Aeron parameter server, SURVEY §2.4); our
+ClusterTrainer — like any plain ``jax.distributed`` job — dies (or hangs)
+when ANY host dies. This module makes membership a first-class, mutable
+property of a training run:
+
+- **Rendezvous over storage, not a new network service.** Workers
+  coordinate exclusively through the existing
+  :class:`~deeplearning4j_tpu.checkpoint.storage.StorageBackend` byte-store
+  (the same medium the checkpoints ride): per-worker **lease** objects
+  refreshed by a heartbeat thread, immutable-per-generation **membership
+  records** (``gen-N``), and **bump** breadcrumbs requesting a generation's
+  supersession. Liveness = lease freshness under a TTL; the membership for
+  generation N+1 forms once every live lease has either joined the barrier
+  (``barrier >= N+1``) or expired — so a dead worker delays the bump by at
+  most one TTL, and a merely-slow worker is waited for.
+
+- **Leader = smallest live worker id.** The leader writes the membership
+  record and hosts the generation's ``jax.distributed`` coordinator on a
+  fresh port. Two would-be leaders (an expired-but-alive old leader racing
+  the new one) converge by read-back: after writing, everyone adopts
+  whatever record the store actually holds; a worker the record excludes
+  REJOINS at the next generation instead of continuing — that, plus
+  generation-fenced checkpoint commits (``CheckpointManager.commit_guard``),
+  is the split-brain guard: a stale generation can neither train (its
+  collectives have no peers) nor journal checkpoints over the live run.
+
+- **Re-initialize, don't restart (when possible).** At an epoch boundary,
+  a membership change tears the collective runtime down IN-PROCESS
+  (:class:`ElasticRuntime`), re-initializes ``jax.distributed`` with the
+  new world size, rebuilds the mesh, restores the last epoch checkpoint
+  (sharded N→M reshard-on-restore, checkpoint/sharded.py) and re-shards
+  the data by the new (rank, world). A hung collective MID-epoch — the
+  dead-peer signature a CollectiveWatchdog deadline catches — escalates to
+  a membership bump the same way: the wedged dispatch is abandoned on its
+  daemon thread, the runtime is rebuilt, and training resumes from the
+  epoch checkpoint with the survivors. Only when teardown itself fails
+  does the worker raise :class:`ElasticRestartRequired`, telling the
+  process supervisor (checkpoint/supervisor.py) to respawn it fresh.
+
+- **The XLA coordination service is configured OUT of failure detection.**
+  ``jax.distributed.initialize`` installs a client whose reaction to a
+  dead peer is to terminate the process (and this jaxlib's Python
+  ``missed_heartbeat_callback`` binding aborts on invocation), so
+  :class:`ElasticRuntime` builds the service/client directly with an
+  effectively-infinite heartbeat budget and ``shutdown_on_destruction=
+  False``: the leases + watchdog above own failure detection, and
+  torn-down runtimes are leaked into a graveyard (never shut down — the
+  shutdown barrier cannot complete with a dead peer) until process exit.
+
+Determinism: membership changes land only at epoch boundaries, every
+epoch ends in a sharded checkpoint, and a restore replays the exact
+params/opt-state/RNG — so a SAME-world-size restart (e.g. a whole-job
+preemption respawned by the supervisor) is bitwise-identical to the
+uninterrupted run, and a shrunk/grown world resumes from exactly the last
+epoch state (training beyond that point differs only by all-reduce
+topology). tests/test_resilience.py asserts both.
+
+Clocks: lease freshness compares store-written wall timestamps against
+the OBSERVER's clock, so skew can mis-declare a live worker dead (it
+rejoins at the next generation — churn, never split-brain) but cannot
+corrupt state; ``clock=`` is injectable for the skew tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import socket
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+LEASE_PREFIX = "lease-"
+GEN_PREFIX = "gen-"
+BUMP_PREFIX = "bump-"
+
+# heartbeat budget that neutralizes the XLA coordination service's own
+# failure detection (~11 days at 10s beats): the elastic layer's leases +
+# the CollectiveWatchdog own dead-peer detection instead
+_NEUTRAL_HEARTBEAT_S = 10
+_NEUTRAL_MISSING = 100000
+
+__all__ = [
+    "ElasticError", "RendezvousTimeout",
+    "ElasticRestartRequired", "StaleGenerationError", "Membership",
+    "LeaseBoard", "Rendezvous", "ElasticRuntime", "ElasticWorker",
+    "GenerationRecord", "ElasticRunSummary",
+]
+
+
+class ElasticError(RuntimeError):
+    """Base class for elastic-layer failures."""
+
+
+class RendezvousTimeout(ElasticError):
+    """No membership formed within the join deadline (store outage, no
+    leader, or every peer gone)."""
+
+
+class StaleGenerationError(ElasticError):
+    """A checkpoint commit was attempted by a generation the store says is
+    superseded — the generation fence that keeps an evicted-but-alive
+    leader from journaling over the live run."""
+
+
+class ElasticRestartRequired(ElasticError):
+    """In-process recovery is not possible (runtime teardown failed);
+    the process should exit and be respawned by the supervisor
+    (checkpoint/supervisor.py maps this to ``ELASTIC_RESTART_EXIT``)."""
+
+
+class _MembershipChanged(ElasticError):
+    """Internal epoch-boundary signal: re-rendezvous."""
+
+
+# =========================================================== membership data
+@dataclasses.dataclass
+class Membership:
+    """One generation's committed membership (immutable once adopted)."""
+    generation: int
+    members: List[str]            # sorted worker ids; members[0] leads
+    coordinator: str              # "host:port" of the jax.distributed svc
+    reason: str = ""
+    writer: str = ""
+
+    def rank_of(self, worker_id: str) -> int:
+        return self.members.index(worker_id)
+
+    @property
+    def world_size(self) -> int:
+        return len(self.members)
+
+    def to_json(self) -> bytes:
+        return json.dumps(dataclasses.asdict(self)).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "Membership":
+        d = json.loads(data.decode())
+        return cls(generation=int(d["generation"]),
+                   members=list(d["members"]),
+                   coordinator=str(d["coordinator"]),
+                   reason=d.get("reason", ""), writer=d.get("writer", ""))
+
+
+def _gen_name(generation: int) -> str:
+    return f"{GEN_PREFIX}{generation:06d}"
+
+
+def _bump_name(generation: int) -> str:
+    return f"{BUMP_PREFIX}{generation:06d}"
+
+
+# ================================================================== leases
+class LeaseBoard:
+    """Per-worker heartbeat leases in the store.
+
+    A lease is ``lease-<worker_id>`` holding ``{worker_id, incarnation,
+    seq, time, barrier}``; a background thread refreshes it every
+    ``heartbeat_s`` (default ttl/3). ``barrier`` is the generation this
+    worker is ready to join — the rendezvous settles when every LIVE lease
+    has either reached the barrier or expired. Store faults during a
+    heartbeat are counted and logged, not fatal: liveness tolerates
+    missed beats up to the TTL (chaos tests inject FlakyBackend faults
+    here on purpose)."""
+
+    def __init__(self, store, worker_id: str, ttl_s: float = 10.0,
+                 heartbeat_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.time):
+        from deeplearning4j_tpu.checkpoint.storage import as_backend
+        self.store = as_backend(store)
+        self.worker_id = str(worker_id)
+        self.ttl_s = float(ttl_s)
+        self.heartbeat_s = (float(heartbeat_s) if heartbeat_s is not None
+                            else self.ttl_s / 3.0)
+        self.clock = clock
+        self.incarnation = uuid.uuid4().hex[:12]
+        self._lock = threading.Lock()
+        self._barrier_gen = 0
+        self._seq = 0
+        self._last_write = float("-inf")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.heartbeat_errors = 0
+
+    # ------------------------------------------------------------- writing
+    def write(self, barrier: Optional[int] = None):
+        """Write this worker's lease now (also what the heartbeat thread
+        calls). ``barrier`` updates the joined-generation marker."""
+        with self._lock:
+            if barrier is not None:
+                self._barrier_gen = int(barrier)
+            self._seq += 1
+            rec = {"worker_id": self.worker_id,
+                   "incarnation": self.incarnation,
+                   "seq": self._seq,
+                   "time": self.clock(),
+                   "barrier": self._barrier_gen}
+        self.store.put(LEASE_PREFIX + self.worker_id,
+                       json.dumps(rec).encode())
+        self._last_write = self.clock()
+
+    def refresh_if_due(self):
+        """Heartbeat inline when no beat landed for a heartbeat interval
+        — keeps a worker alive through long WAITS (the rendezvous poll
+        loop) even when the background thread isn't running."""
+        if self.clock() - self._last_write >= self.heartbeat_s:
+            self.write()
+
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+
+        def beat():
+            while not self._stop.wait(self.heartbeat_s):
+                try:
+                    self.write()
+                except Exception as e:
+                    # a missed beat is survivable until the TTL; chaos
+                    # tests inject faults here deliberately
+                    self.heartbeat_errors += 1
+                    log.warning("lease heartbeat for %s failed (%s: %s)",
+                                self.worker_id, type(e).__name__, e)
+        self._thread = threading.Thread(
+            target=beat, name=f"lease-{self.worker_id}", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.heartbeat_s * 2 + 1)
+            self._thread = None
+
+    # ------------------------------------------------------------- reading
+    def read_all(self) -> Dict[str, dict]:
+        """Every parseable lease in the store, by worker id."""
+        out = {}
+        for name in self.store.list(prefix=LEASE_PREFIX):
+            try:
+                rec = json.loads(self.store.get(name).decode())
+                out[str(rec["worker_id"])] = rec
+            except Exception as e:
+                # an unreadable lease counts as absent (= expired); log so
+                # persistent corruption is visible
+                log.warning("unreadable lease %s (%s: %s)", name,
+                            type(e).__name__, e)
+        return out
+
+    def is_fresh(self, rec: dict, now: Optional[float] = None) -> bool:
+        now = self.clock() if now is None else now
+        return (now - float(rec.get("time", 0))) <= self.ttl_s
+
+    def live(self, leases: Optional[Dict[str, dict]] = None) -> Dict[str, dict]:
+        leases = self.read_all() if leases is None else leases
+        now = self.clock()
+        return {w: r for w, r in leases.items() if self.is_fresh(r, now)}
+
+    def withdraw(self):
+        """Delete this worker's lease (clean exit — peers need not wait a
+        TTL to notice)."""
+        try:
+            self.store.delete(LEASE_PREFIX + self.worker_id)
+        except Exception as e:
+            log.warning("lease withdraw for %s failed (%s: %s)",
+                        self.worker_id, type(e).__name__, e)
+
+
+# =============================================================== rendezvous
+def _pick_free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+class Rendezvous:
+    """The membership protocol over the store (see module docstring)."""
+
+    def __init__(self, store, lease_board: LeaseBoard,
+                 join_timeout_s: float = 60.0, poll_s: float = 0.2,
+                 scaledown_grace_s: float = 0.0,
+                 advertise_host: str = "localhost",
+                 pick_port: Callable[[], int] = _pick_free_port,
+                 sleep: Callable[[float], None] = time.sleep):
+        from deeplearning4j_tpu.checkpoint.storage import as_backend
+        self.store = as_backend(store)
+        self.leases = lease_board
+        self.worker_id = lease_board.worker_id
+        self.clock = lease_board.clock
+        self.join_timeout_s = float(join_timeout_s)
+        self.poll_s = float(poll_s)
+        # how long a leader-elect holds a membership SMALLER than the
+        # previous generation's before committing it: a whole-fleet
+        # preemption respawns workers on a slow path (process start +
+        # imports), and without the grace the first one back would form a
+        # world of one and train ahead alone. Availability cost: each
+        # genuine shrink commits this much later. Keep it under
+        # join_timeout_s.
+        self.scaledown_grace_s = float(scaledown_grace_s)
+        self.advertise_host = advertise_host
+        self.pick_port = pick_port
+        self.sleep = sleep
+        self.evictions = 0
+        self.memberships_written = 0
+
+    # -------------------------------------------------------------- records
+    def current(self) -> Optional[Membership]:
+        """Highest committed membership record, or None before gen 1."""
+        from deeplearning4j_tpu.checkpoint.storage import StorageNotFoundError
+        names = self.store.list(prefix=GEN_PREFIX)
+        for name in reversed(sorted(names)):
+            try:
+                return Membership.from_json(self.store.get(name))
+            except StorageNotFoundError:
+                continue  # raced a writer; try the next older record
+            except Exception as e:
+                log.warning("unreadable membership %s (%s: %s) — skipping",
+                            name, type(e).__name__, e)
+        return None
+
+    def request_bump(self, generation: int, reason: str):
+        """Ask for ``generation`` to be superseded (idempotent, best
+        effort: the lease/expiry rules drive the actual bump; this is the
+        fast path + the observability breadcrumb)."""
+        name = _bump_name(generation)
+        try:
+            if not self.store.exists(name):
+                self.store.put(name, json.dumps({
+                    "generation": generation, "reason": reason,
+                    "worker": self.worker_id,
+                    "time": self.clock()}).encode())
+        except Exception as e:
+            log.warning("bump request for gen %d failed (%s: %s)",
+                        generation, type(e).__name__, e)
+
+    def bump_requested(self, generation: int) -> Optional[str]:
+        from deeplearning4j_tpu.checkpoint.storage import StorageError
+        try:
+            data = self.store.get(_bump_name(generation))
+        except (StorageError, OSError):
+            return None
+        try:
+            rec = json.loads(data.decode())
+            return f"{rec.get('reason', 'bump')} (by {rec.get('worker')})"
+        except ValueError:
+            return "bump (unreadable record)"
+
+    # ---------------------------------------------------------------- join
+    def propose_or_await(self, want_gen: int,
+                         expected: Optional[int] = None,
+                         reason: str = "") -> Membership:
+        """Join generation >= ``want_gen``; returns the adopted membership
+        this worker belongs to. The leader (smallest live id at the
+        barrier) writes the record once every live lease has either
+        joined or expired; everyone — including a duelling would-be
+        leader — adopts the record the store actually holds (read-back
+        convergence). A worker excluded by the adopted record retries at
+        the NEXT generation (eviction → rejoin, never split-brain).
+        ``expected`` (first generation only) additionally waits for that
+        many workers so a fast starter cannot form a world of one."""
+        deadline = self.clock() + self.join_timeout_s
+        want = int(want_gen)
+        first_settle: Optional[float] = None
+        self.leases.write(barrier=want)
+        while True:
+            cur = self.current()
+            if cur is not None and cur.generation >= want:
+                if self.worker_id in cur.members:
+                    self.leases.write(barrier=cur.generation)
+                    return cur
+                # committed without us: our lease looked dead. Rejoin.
+                self.evictions += 1
+                log.warning("%s evicted from gen %d (%s) — rejoining at "
+                            "gen %d", self.worker_id, cur.generation,
+                            cur.reason, cur.generation + 1)
+                want = cur.generation + 1
+                self.leases.write(barrier=want)
+            if self.clock() > deadline:
+                raise RendezvousTimeout(
+                    f"{self.worker_id}: no membership for gen >= "
+                    f"{want_gen} within {self.join_timeout_s:.0f}s")
+            try:
+                self.leases.refresh_if_due()  # stay alive while waiting
+            except Exception as e:
+                log.warning("lease refresh during rendezvous failed "
+                            "(%s: %s)", type(e).__name__, e)
+            leases = self.leases.read_all()
+            live = self.leases.live(leases)
+            cands = sorted(w for w, r in live.items()
+                           if int(r.get("barrier", 0)) >= want)
+            settled = bool(cands) and all(
+                int(r.get("barrier", 0)) >= want for r in live.values())
+            if expected is not None and len(cands) < expected:
+                settled = False
+            if settled and cands[0] == self.worker_id:
+                prev = cur  # highest committed record, read this loop
+                if (self.scaledown_grace_s > 0 and prev is not None
+                        and prev.generation < want
+                        and len(cands) < len(prev.members)):
+                    if first_settle is None:
+                        first_settle = self.clock()
+                    if self.clock() - first_settle < self.scaledown_grace_s:
+                        self.sleep(self.poll_s)
+                        continue  # a respawning member may yet come back
+                port = self.pick_port()
+                rec = Membership(
+                    generation=want, members=cands,
+                    coordinator=f"{self.advertise_host}:{port}",
+                    reason=reason, writer=self.worker_id)
+                try:
+                    self.store.put(_gen_name(want), rec.to_json())
+                    self.memberships_written += 1
+                except Exception as e:
+                    log.warning("membership write for gen %d failed "
+                                "(%s: %s) — retrying", want,
+                                type(e).__name__, e)
+                # loop: adopt the read-back record (ours, or a duelling
+                # writer's — last put wins and everyone converges on it)
+                continue
+            self.sleep(self.poll_s)
+
+    # ------------------------------------------------------ change detection
+    def membership_changed(self, m: Membership) -> Optional[str]:
+        """Epoch-boundary probe: why (if at all) generation ``m`` must
+        end. Returns a reason string or None."""
+        cur = self.current()
+        if cur is not None and cur.generation > m.generation:
+            return f"superseded by gen {cur.generation} ({cur.reason})"
+        bump = self.bump_requested(m.generation)
+        if bump:
+            return f"bump requested: {bump}"
+        leases = self.leases.read_all()
+        live = self.leases.live(leases)
+        dead = [w for w in m.members if w not in live]
+        if dead:
+            return f"peer lease expired: {sorted(dead)}"
+        joiners = [w for w in sorted(live) if w not in m.members]
+        if joiners:
+            return f"new worker(s) waiting: {joiners}"
+        ahead = [w for w in m.members
+                 if int(live.get(w, {}).get("barrier", 0)) > m.generation]
+        if ahead:
+            return f"peer(s) moved to a later generation: {sorted(ahead)}"
+        return None
+
+
+# ====================================================== collective runtime
+class ElasticRuntime:
+    """Join/leave ``jax.distributed`` with a mutable world size.
+
+    Builds the coordination service/client directly (see module
+    docstring: neutralized heartbeats, no shutdown-on-destruction) and
+    REPLACES the backend view on every transition via
+    ``xla_bridge._clear_backends()``. Torn-down clients/services are
+    leaked into a graveyard — with a dead peer their shutdown barrier can
+    never complete, and with detection neutralized they stay quiet until
+    process exit. World size 1 skips ``jax.distributed`` entirely."""
+
+    def __init__(self, init_timeout_s: float = 60.0):
+        self.init_timeout_s = float(init_timeout_s)
+        self._graveyard: list = []   # deliberate leaks, for the process's
+        self._joined_multi = False   # lifetime (a handful per run)
+        self.joins = 0
+
+    @staticmethod
+    def _set_cpu_collectives(impl: str):
+        import jax
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", impl)
+        except Exception as e:
+            log.debug("cpu collectives flag unavailable (%s)", e)
+
+    @staticmethod
+    def _reset_backend_view():
+        """Rebuild jax's device/world view from the CURRENT distributed
+        state. ``_clear_backends`` alone is not enough: ``process_count``
+        and ``local_devices`` are lru-cached at the API layer and would
+        keep reporting the PREVIOUS generation's world."""
+        from jax._src import xla_bridge as xb
+        xb._clear_backends()
+        for fn_name in ("process_count", "local_devices"):
+            fn = getattr(xb, fn_name, None)
+            if hasattr(fn, "cache_clear"):
+                fn.cache_clear()
+
+    def join(self, coordinator: str, num_processes: int, process_id: int):
+        import jax
+        from jax._src import distributed
+        if self._joined_multi:
+            self.leave()
+        if num_processes <= 1:
+            return
+        from jax._src.lib import xla_extension
+        st = distributed.global_state
+        if st.client is not None:
+            raise ElasticError(
+                "jax.distributed is already initialized outside the "
+                "elastic runtime; elastic training owns the collective "
+                "runtime lifecycle and cannot take over an existing one")
+        # multi-process CPU needs gloo collectives; the flag is only read
+        # by the CPU client, so setting it is harmless on TPU. leave()
+        # resets it to "none" — a gloo CPU client cannot be built without
+        # a distributed client, so the flag must track the join state.
+        self._set_cpu_collectives("gloo")
+        service = None
+        if process_id == 0:
+            bind = "[::]:" + coordinator.rsplit(":", 1)[1]
+            service = xla_extension.get_distributed_runtime_service(
+                bind, num_processes,
+                heartbeat_interval=_NEUTRAL_HEARTBEAT_S,
+                max_missing_heartbeats=_NEUTRAL_MISSING,
+                shutdown_timeout=5)
+        try:
+            client = xla_extension.get_distributed_runtime_client(
+                coordinator, process_id,
+                init_timeout=int(self.init_timeout_s),
+                shutdown_timeout=5,
+                heartbeat_interval=_NEUTRAL_HEARTBEAT_S,
+                max_missing_heartbeats=_NEUTRAL_MISSING,
+                shutdown_on_destruction=False,
+                use_compression=True)
+            client.connect()  # bounded by init_timeout; raises on failure
+        except Exception:
+            if service is not None:
+                self._graveyard.append((None, service))
+            # the gloo flag must not outlive the join attempt: with no
+            # distributed client behind it, the next (world-of-1) backend
+            # build would fail outright
+            self._set_cpu_collectives("none")
+            raise
+        st.service = service if service is not None else st.service
+        st.client = client
+        st.process_id = int(process_id)
+        st.num_processes = int(num_processes)
+        st.coordinator_address = coordinator
+        self._reset_backend_view()
+        self._joined_multi = True
+        self.joins += 1
+        if jax.process_count() != num_processes:
+            raise ElasticError(
+                f"runtime came up with {jax.process_count()} processes, "
+                f"expected {num_processes}")
+
+    def leave(self, graceful: bool = False):
+        """Detach from the current collective runtime.
+
+        ``graceful=False`` (crash/hang path): NO shutdown barrier — it
+        cannot complete when a peer is dead, the very reason we are
+        leaving. The old client/service are leaked into the graveyard;
+        their gloo transports keep their sockets, which a later
+        generation's connection storm can trip over — the worker's
+        XlaRuntimeError→process-restart escalation covers that.
+
+        ``graceful=True`` (healthy boundary: cooperative re-shard or
+        completion, every member leaving TOGETHER): run the real
+        ``client.shutdown()`` barrier and drop the references, so the
+        gloo contexts are destroyed and nothing stale lingers. Falls back
+        to the leak path if the barrier fails or wedges (bounded)."""
+        if not self._joined_multi:
+            return
+        import jax
+        from jax._src import distributed
+        st = distributed.global_state
+        client, service = st.client, st.service
+        cleaned = False
+        if graceful and client is not None:
+            from deeplearning4j_tpu.parallel.watchdog import (
+                CollectiveWatchdog)
+
+            def _shutdown():
+                client.shutdown()  # barrier across all (live) members
+                if service is not None:
+                    service.shutdown()
+            try:
+                CollectiveWatchdog(timeout_s=20.0).call(
+                    _shutdown, what="graceful collective shutdown")
+                cleaned = True
+            except Exception as e:
+                log.warning("graceful runtime shutdown failed (%s: %s) — "
+                            "leaking it instead", type(e).__name__, e)
+        if not cleaned:
+            self._graveyard.append((client, service))
+        st.client = None
+        st.service = None
+        st.preemption_sync_manager = None
+        st.process_id = 0
+        st.num_processes = 1
+        st.coordinator_address = None
+        self._set_cpu_collectives("none")  # no client to back gloo now
+        self._reset_backend_view()
+        try:
+            jax.clear_caches()  # executables over dead backends
+        except Exception as e:
+            log.debug("clear_caches failed during elastic leave (%s)", e)
+        self._joined_multi = False
+
+
+def _is_xla_runtime_error(e: BaseException) -> bool:
+    try:
+        from jax._src.lib import xla_extension
+        return isinstance(e, xla_extension.XlaRuntimeError)
+    except (ImportError, AttributeError):
+        return type(e).__name__ == "XlaRuntimeError"
+
+
+# ============================================================ elastic worker
+@dataclasses.dataclass
+class GenerationRecord:
+    """One generation as this worker experienced it."""
+    generation: int
+    world_size: int
+    rank: int
+    epochs: int = 0
+    restored_from: Optional[str] = None   # journal entry file, if restored
+    ended: str = ""                       # why the generation ended
+    wall_s: float = 0.0
+
+
+@dataclasses.dataclass
+class ElasticRunSummary:
+    """What happened across the whole elastic run on THIS worker."""
+    worker_id: str
+    completed: bool
+    epochs: int
+    generations: List[GenerationRecord]
+    evictions: int
+    model: object = None
+
+    def __str__(self):
+        gens = "; ".join(
+            f"g{g.generation}[{g.rank}/{g.world_size}]x{g.epochs}ep"
+            + (f" ({g.ended})" if g.ended else "")
+            for g in self.generations)
+        return (f"elastic[{self.worker_id}]: completed={self.completed} "
+                f"epochs={self.epochs} evictions={self.evictions} [{gens}]")
+
+
+class ElasticWorker:
+    """One worker of an elastic training job (run one per process).
+
+    Usage (per worker process)::
+
+        cm = CheckpointManager(storage=backend, sharded=True,
+                               async_write=False)
+        worker = ElasticWorker(store=backend, worker_id="w0",
+                               checkpoint_manager=cm, num_workers=4)
+        summary = worker.run(model_factory, data, num_epochs=10)
+
+    ``store`` is the rendezvous medium (any StorageBackend or a
+    directory); it may be the checkpoint store itself or a sibling.
+    ``num_workers`` is the expected INITIAL quorum — later generations
+    form from whoever holds a fresh lease. ``data`` is a re-iterable of
+    global DataSet batches (each worker takes its row shard per its rank
+    in the current generation — the membership-change re-sharding) or a
+    callable ``(rank, world_size) -> iterable`` for custom sharding.
+    ``on_generation(model, membership, rank, world)`` runs after every
+    (re)build — chaos tests attach fault injectors there; production code
+    re-attaches listeners a restored model does not carry.
+    """
+
+    def __init__(self, store, worker_id: str, checkpoint_manager,
+                 num_workers: Optional[int] = None,
+                 lease_ttl_s: float = 10.0,
+                 heartbeat_s: Optional[float] = None,
+                 join_timeout_s: float = 120.0,
+                 poll_s: float = 0.2,
+                 scaledown_grace_s: float = 0.0,
+                 collective_timeout_s: Optional[float] = 60.0,
+                 init_timeout_s: float = 60.0,
+                 max_generations: int = 50,
+                 max_consecutive_failures: int = 3,
+                 advertise_host: str = "localhost",
+                 clock: Callable[[], float] = time.time,
+                 on_generation: Optional[Callable] = None):
+        from deeplearning4j_tpu.checkpoint.storage import as_backend
+        self.store = as_backend(store)
+        self.worker_id = str(worker_id)
+        self.cm = checkpoint_manager
+        self.num_workers = num_workers
+        self.collective_timeout_s = collective_timeout_s
+        self.max_generations = int(max_generations)
+        self.max_consecutive_failures = int(max_consecutive_failures)
+        self.on_generation = on_generation
+        self.leases = LeaseBoard(self.store, worker_id, ttl_s=lease_ttl_s,
+                                 heartbeat_s=heartbeat_s, clock=clock)
+        self.rendezvous = Rendezvous(self.store, self.leases,
+                                     join_timeout_s=join_timeout_s,
+                                     poll_s=poll_s,
+                                     scaledown_grace_s=scaledown_grace_s,
+                                     advertise_host=advertise_host)
+        self.runtime = ElasticRuntime(init_timeout_s=init_timeout_s)
+
+    # ------------------------------------------------------------ internals
+    def _assert_current(self, m: Membership):
+        """Checkpoint commit fence: refuse to journal from a superseded
+        generation (the split-brain guard for an evicted-but-alive
+        leader). TOCTOU-approximate like any lease fence — the window is
+        one store read at epoch cadence."""
+        cur = self.rendezvous.current()
+        if cur is not None and cur.generation != m.generation:
+            raise StaleGenerationError(
+                f"{self.worker_id} (gen {m.generation}) refusing to "
+                f"journal a checkpoint: store is at gen {cur.generation}")
+
+    def _boundary_vote(self, local_change: Optional[str],
+                       world: int) -> Optional[str]:
+        """Epoch-boundary membership decision, made COLLECTIVELY: each
+        member contributes its local store observation and everyone
+        adopts "change" if anyone saw one — so the whole generation exits
+        at the SAME boundary and the graceful runtime shutdown's barrier
+        can complete. (One tiny all-gather per epoch; a dead peer makes
+        it hang, which the watchdog deadline turns into the usual
+        escalation.)"""
+        if world <= 1:
+            return local_change
+        import numpy as np_
+        from deeplearning4j_tpu.parallel.watchdog import CollectiveWatchdog
+
+        def vote():
+            from jax.experimental import multihost_utils
+            flags = multihost_utils.process_allgather(
+                np_.array([1 if local_change else 0], np_.int32))
+            return int(np_.asarray(flags).sum())
+        n = CollectiveWatchdog(
+            timeout_s=self.collective_timeout_s or 60.0).call(
+                vote, what="membership boundary vote")
+        if local_change is not None:
+            return local_change
+        return "peer detected a membership change" if n > 0 else None
+
+    def _data_for(self, data, rank: int, world: int):
+        if callable(data):
+            return data(rank, world)
+        if world <= 1:
+            return data
+        from deeplearning4j_tpu.parallel.sharding import shard_iterator
+        return shard_iterator(data, rank, world)
+
+    def _build_model(self, model_factory, rec: GenerationRecord):
+        restored = self.cm.restore_latest()
+        if restored is not None:
+            rec.restored_from = (restored._restored_from.path
+                                 if restored._restored_from else None)
+            return restored
+        return model_factory()
+
+    # ----------------------------------------------------------------- run
+    def run(self, model_factory: Callable, data, num_epochs: int,
+            ) -> ElasticRunSummary:
+        """Train to ``num_epochs`` total epochs across however many
+        membership generations it takes; returns when this worker has
+        seen the final epoch complete. Raises ``RendezvousTimeout`` /
+        ``ElasticError`` when no quorum forms, ``ElasticRestartRequired``
+        when only a process respawn can recover."""
+        from deeplearning4j_tpu.parallel.trainer import ClusterTrainer
+        from deeplearning4j_tpu.parallel.watchdog import (
+            CollectiveTimeoutError)
+        gens: List[GenerationRecord] = []
+        self.leases.start()
+        model = None
+        consecutive = 0
+        try:
+            cur = self.rendezvous.current()
+            want = 1 if cur is None else cur.generation + 1
+            first = cur is None
+            while True:
+                if len(gens) >= self.max_generations:
+                    raise ElasticError(
+                        f"exceeded max_generations={self.max_generations} "
+                        "— the membership is churning faster than "
+                        "training progresses")
+                m = self.rendezvous.propose_or_await(
+                    want, expected=(self.num_workers if first else None),
+                    reason="initial quorum" if first else "membership change")
+                first = False
+                rank, world = m.rank_of(self.worker_id), m.world_size
+                rec = GenerationRecord(generation=m.generation,
+                                       world_size=world, rank=rank)
+                gens.append(rec)
+                clean_boundary = False
+                t0 = time.monotonic()
+                try:
+                    self.runtime.join(m.coordinator, world, rank)
+                except Exception as e:
+                    # ANY join failure retries at the next generation —
+                    # the common one is client.connect() raising
+                    # XlaRuntimeError after the gen's coordinator died
+                    # between writing the record and serving it
+                    rec.ended = f"join failed: {type(e).__name__}: {e}"
+                    log.warning("%s gen %d join failed (%s: %s)",
+                                self.worker_id, m.generation,
+                                type(e).__name__, e)
+                    self.rendezvous.request_bump(
+                        m.generation,
+                        f"join failed on {self.worker_id}: "
+                        f"{type(e).__name__}")
+                    self.runtime.leave()  # drop any half-built state
+                    consecutive += 1
+                    if consecutive >= self.max_consecutive_failures:
+                        raise ElasticError(
+                            f"{self.worker_id}: {consecutive} consecutive "
+                            f"join failures (last: {type(e).__name__}: "
+                            f"{e})") from e
+                    want = m.generation + 1
+                    continue
+                try:
+                    # re-read the journal from storage: in-process
+                    # survivors only APPEND entries locally on the host
+                    # that journals (the leader) — without the refresh a
+                    # non-leader would restore an older checkpoint than
+                    # its peers and the generation's collectives would
+                    # diverge. Also re-agrees the save sequence counter
+                    # fleet-wide after failed/partial save attempts.
+                    self.cm.refresh()
+                    model = self._build_model(model_factory, rec)
+                    self.cm.fence(model)
+                    self.cm.commit_guard = lambda m=m: self._assert_current(m)
+                    if not self.cm.checkpoints():
+                        # epoch-0 set: even a crash in epoch 1 restores
+                        # pristine state instead of refitting a maybe-
+                        # different fresh model
+                        self.cm.save(model)
+                    trainer = ClusterTrainer(model)
+                    local = self._data_for(data, rank, world)
+                    if self.on_generation is not None:
+                        self.on_generation(model, m, rank, world)
+                    while model.epoch < num_epochs:
+                        # exactly ONE epoch per fit call: num_epochs is
+                        # the run TOTAL when a restored model carries a
+                        # resume marker (first call after restore) and a
+                        # relative count otherwise
+                        target = (model.epoch + 1
+                                  if getattr(model, "_resume_state", None)
+                                  is not None else 1)
+                        trainer.fit_local_shard(
+                            local, num_epochs=target,
+                            collective_timeout_s=self.collective_timeout_s,
+                            watchdog_every=1)
+                        consecutive = 0
+                        self.cm.save(model)
+                        rec.epochs += 1
+                        if model.epoch >= num_epochs:
+                            break  # done: no boundary vote after the end
+                        change = self._boundary_vote(
+                            self.rendezvous.membership_changed(m), world)
+                        if change is not None:
+                            raise _MembershipChanged(change)
+                    rec.ended = "completed"
+                    rec.wall_s = time.monotonic() - t0
+                    self._leave_guarded(graceful=True)
+                    total = sum(g.epochs for g in gens)
+                    summary = ElasticRunSummary(
+                        worker_id=self.worker_id, completed=True,
+                        epochs=total, generations=gens,
+                        evictions=self.rendezvous.evictions, model=model)
+                    log.info("%s", summary)
+                    return summary
+                except _MembershipChanged as e:
+                    rec.ended = str(e)
+                    clean_boundary = True  # whole generation left together
+                    log.info("%s gen %d ends at epoch boundary: %s",
+                             self.worker_id, m.generation, e)
+                    self.rendezvous.request_bump(m.generation, str(e))
+                except CollectiveTimeoutError as e:
+                    # THE escalation: a hung mid-epoch collective (dead
+                    # peer) becomes a membership bump, not a dead job. The
+                    # wedged dispatch thread is already abandoned
+                    # (daemon); training resumes from the epoch checkpoint
+                    rec.ended = f"collective timeout -> membership bump"
+                    log.warning("%s gen %d: hung collective (%s) — "
+                                "escalating to membership bump",
+                                self.worker_id, m.generation, e)
+                    self.rendezvous.request_bump(
+                        m.generation, f"collective timeout on "
+                        f"{self.worker_id}")
+                    consecutive += 1
+                except StaleGenerationError as e:
+                    rec.ended = f"fenced: {e}"
+                    log.warning("%s: %s — rejoining", self.worker_id, e)
+                except Exception as e:
+                    rec.ended = f"{type(e).__name__}: {e}"
+                    self.rendezvous.request_bump(
+                        m.generation, f"{type(e).__name__} on "
+                        f"{self.worker_id}")
+                    if world > 1 and _is_xla_runtime_error(e):
+                        # an ERRORED (not merely hung) collective can
+                        # poison the process — gloo's transport threads
+                        # may std::terminate later no matter what Python
+                        # does. In-process recovery is off the table;
+                        # exit and let the supervisor respawn us into the
+                        # next generation (the SIGKILL-proof path).
+                        rec.ended = (f"collective runtime error -> "
+                                     f"process restart ({e})")
+                        log.warning("%s gen %d: collective runtime error "
+                                    "(%s) — escalating to process restart",
+                                    self.worker_id, m.generation, e)
+                        raise ElasticRestartRequired(
+                            f"collective runtime error on "
+                            f"{self.worker_id}: {e}") from e
+                    log.warning("%s gen %d failed (%s: %s) — requesting "
+                                "membership bump", self.worker_id,
+                                m.generation, type(e).__name__, e)
+                    consecutive += 1
+                    if consecutive >= self.max_consecutive_failures:
+                        raise
+                finally:
+                    rec.wall_s = time.monotonic() - t0
+                # a synchronized boundary exit tears down cooperatively
+                # (real shutdown barrier, gloo contexts destroyed);
+                # crash/hang exits leak the runtime instead
+                self._leave_guarded(graceful=clean_boundary)
+                cur = self.rendezvous.current()
+                want = max(m.generation,
+                           cur.generation if cur else 0) + 1
+        finally:
+            self.cm.commit_guard = None
+            self.cm.fence(None)
+            self.leases.stop()
+            self.leases.withdraw()
+
+    def _leave_guarded(self, graceful: bool = False):
+        """Teardown bounded by a deadline; a teardown that itself wedges
+        means in-process recovery is off the table — escalate to a
+        process restart."""
+        from deeplearning4j_tpu.parallel.watchdog import (
+            CollectiveTimeoutError, CollectiveWatchdog)
+        try:
+            CollectiveWatchdog(timeout_s=45.0).call(
+                lambda: self.runtime.leave(graceful=graceful),
+                what="elastic runtime teardown")
+        except CollectiveTimeoutError as e:
+            raise ElasticRestartRequired(
+                f"collective runtime teardown wedged on {self.worker_id}; "
+                "process must be respawned") from e

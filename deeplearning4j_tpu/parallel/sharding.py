@@ -25,6 +25,32 @@ from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterators import DataSetIterator
 
 
+class UnequalShardError(ValueError):
+    """Process-local batch sizes differ across hosts. Raised BEFORE global
+    batch assembly: feeding unequal local shards to
+    ``jax.make_array_from_process_local_data`` fails (or hangs a peer)
+    deep inside array construction with no hint of which host is off —
+    this error names every host's count and the fix instead."""
+
+
+def check_equal_local_shards(counts: Sequence[int]) -> None:
+    """Validate one all-gathered vector of per-process local batch sizes
+    (index = process index). Raises :class:`UnequalShardError` naming the
+    offenders — the single definition ClusterTrainer's pre-assembly check
+    uses and tests can hit directly."""
+    counts = [int(c) for c in counts]
+    if len(set(counts)) <= 1:
+        return
+    per = ", ".join(f"p{i}={c}" for i, c in enumerate(counts))
+    raise UnequalShardError(
+        f"process-local batch sizes differ across hosts: {per}. Every "
+        "host must feed the same local batch size — shard a GLOBAL "
+        "iterator with shard_iterator (equal row slices by construction), "
+        "or drop/pad ragged tail batches identically on every host "
+        "(masked-loss padding via perf.bucketing keeps the epoch one "
+        "compiled program)")
+
+
 def _process_defaults(process_index, num_processes):
     if process_index is None or num_processes is None:
         import jax
@@ -122,4 +148,5 @@ def shard_directory(path: str, pattern: str = "*",
 
 
 __all__ = ["shard_dataset_rows", "shard_iterator", "ShardIterator",
-           "shard_files", "shard_directory"]
+           "shard_files", "shard_directory", "UnequalShardError",
+           "check_equal_local_shards"]

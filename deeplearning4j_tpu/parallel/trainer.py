@@ -294,6 +294,12 @@ class ClusterTrainer(ParallelWrapper):
         trainer.fit_local_shard(local_iterator) # per-host local data
     """
 
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        # whether this epoch's first batch passed the equal-shard check
+        # (see _verify_equal_local_shards)
+        self._epoch_shards_verified = False
+
     @staticmethod
     def initialize(coordinator_address: Optional[str] = None,
                    num_processes: Optional[int] = None,
@@ -307,10 +313,42 @@ class ClusterTrainer(ParallelWrapper):
                                    process_id=process_id)
 
     # ---- multi-host batch assembly ----
+    def _verify_equal_local_shards(self, n_local: int, _gather=None):
+        """Pre-assembly guard: every host must feed the SAME local batch
+        size, or ``make_array_from_process_local_data`` fails (or hangs a
+        peer) deep inside assembly. One all-gather of the local count at
+        the FIRST batch of each epoch raises a named UnequalShardError on
+        every host simultaneously. The check must be an
+        unconditionally-aligned collective: every host runs it at the
+        same batch index or none does — a value-keyed cache would turn it
+        into a conditional collective that deadlocks in exactly the
+        unequal case it exists to catch. (Mid-epoch size changes are not
+        re-verified for the same reason; mismatched per-host sequences of
+        sizes are a systematic sharding bug visible at batch one.)
+        ``_gather`` is injectable for tests."""
+        if self._epoch_shards_verified:
+            return
+        import jax as _jax
+        if _gather is None:
+            if _jax.process_count() == 1:
+                self._epoch_shards_verified = True
+                return
+
+            def _gather(n):
+                from jax.experimental import multihost_utils
+                return np.asarray(multihost_utils.process_allgather(
+                    np.array([n], np.int64))).ravel()
+        from deeplearning4j_tpu.parallel.sharding import (
+            check_equal_local_shards)
+        check_equal_local_shards(_gather(n_local))
+        self._epoch_shards_verified = True
+
     def _assemble_global(self, ds: DataSet) -> DataSet:
         """Build the global sharded batch from this process's LOCAL rows
         (``jax.make_array_from_process_local_data``); single-process falls
         back to a plain sharded device_put."""
+        self._verify_equal_local_shards(ds.num_examples())
+
         def gput(a):
             if a is None:
                 return None
@@ -346,8 +384,9 @@ class ClusterTrainer(ParallelWrapper):
         (shard_iterator guarantees it) — with equal shards this decision is
         identical on all hosts, so no host can drop a batch its peers train
         (which would orphan their collective and hang them). Unequal local
-        shards are a user error and fail loudly in
-        jax.make_array_from_process_local_data rather than hanging."""
+        shards raise a named UnequalShardError BEFORE assembly
+        (_verify_equal_local_shards) listing every host's count, instead
+        of failing opaquely inside make_array_from_process_local_data."""
         local_share = max(1, self.mesh.shape[DATA_AXIS]
                           // max(1, jax.process_count()))
         return bool(ds.num_examples() % local_share)
@@ -431,6 +470,9 @@ class ClusterTrainer(ParallelWrapper):
         step_no = 0
         with self.mesh:
             for _ in range(epochs_to_run):
+                # every host re-verifies at its first batch — an ALIGNED
+                # once-per-epoch collective (see _verify_equal_local_shards)
+                self._epoch_shards_verified = False
                 for listener in self.model.listeners:
                     listener.on_epoch_start(self.model)
                 seen = skip

@@ -22,6 +22,14 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process / long-running tests excluded from the "
+        "tier-1 run (tier-1 uses -m 'not slow'); every slow test must "
+        "carry its own hard timeout so it can never hang a full run")
+
+
 @pytest.fixture(scope="session")
 def devices():
     d = jax.devices()

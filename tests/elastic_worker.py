@@ -1,0 +1,193 @@
+"""Worker process for the elastic-cluster chaos tests.
+
+Run as: python elastic_worker.py <config.json> <worker_id> [attempt]
+
+Each process owns ``devices_per_worker`` virtual CPU devices and runs one
+:class:`~deeplearning4j_tpu.parallel.elastic.ElasticWorker` against a
+shared LocalFS store (rendezvous objects under ``rdzv/``, sharded
+checkpoints under ``ckpt/``). The parent test drives fleets of these
+through ``train_until_process`` (tests/test_resilience.py) — the worker
+learns everything from the config file: world expectations, kill
+schedule (FaultInjector ``kill_mode="process"`` = real SIGKILL), chaos on
+the membership path (FlakyBackend over the rendezvous store), timings.
+
+Outputs (under ``out_dir``):
+
+- ``gen-<wid>-<generation>.json`` — written after every (re)build:
+  membership, rank/world, which checkpoint entry was restored, and the
+  ``state_sha`` digest right after restore (the cross-world N→M
+  reshard-equality probe the parent asserts);
+- ``done-<wid>.json`` — on completion: epochs, iteration, final
+  ``state_sha``, the full generation history, evictions.
+
+Exit codes follow the supervisor protocol: 0 done,
+``ELASTIC_RESTART_EXIT`` when in-process recovery failed, 1 on any other
+error (traceback on stdout). Exits via ``os._exit`` — a wedged collective
+left by a dead peer would hang a normal interpreter exit.
+"""
+
+import json
+import os
+import sys
+
+_CONFIG_PATH, _WORKER_ID = sys.argv[1], sys.argv[2]
+_ATTEMPT = int(sys.argv[3]) if len(sys.argv) > 3 else 1
+with open(_CONFIG_PATH) as _f:
+    CFG = json.load(_f)
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count="
+      f"{int(CFG.get('devices_per_worker', 2))}")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# NOTE: the gloo/none cpu-collectives flag is owned by ElasticRuntime —
+# it must track whether a distributed client exists, so the worker script
+# must NOT pin it here.
+
+import numpy as np  # noqa: E402
+
+
+def _model_factory():
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    conf = (NeuralNetConfiguration.builder()
+            .seed(int(CFG.get("seed", 17)))
+            .updater(Sgd(learning_rate=0.05))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _global_batches():
+    """Deterministic global batches; every worker sees the same list and
+    takes its row shard per its CURRENT rank/world (ElasticWorker wraps
+    this in shard_iterator). Batch size divides every plausible device
+    count so any world re-shards cleanly."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    rng = np.random.default_rng(int(CFG.get("data_seed", 0)))
+    n, batch = int(CFG.get("n_rows", 48)), int(CFG.get("batch", 24))
+    x = rng.random((n, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return DataSet(x, y).split(batch)
+
+
+def main():
+    wid = _WORKER_ID
+    out_dir = CFG["out_dir"]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+
+    from deeplearning4j_tpu.checkpoint import (CheckpointManager,
+                                               FaultInjector, FlakyBackend,
+                                               LocalFSBackend,
+                                               RetryingBackend)
+    from deeplearning4j_tpu.checkpoint import sharded as shd
+    from deeplearning4j_tpu.checkpoint.supervisor import ELASTIC_RESTART_EXIT
+    from deeplearning4j_tpu.parallel.elastic import (ElasticRestartRequired,
+                                                     ElasticWorker)
+
+    rdzv = LocalFSBackend(os.path.join(CFG["store_dir"], "rdzv"))
+    flaky_cfg = CFG.get("flaky")
+    if flaky_cfg:
+        # chaos ON the membership path itself: faults aimed at the
+        # lease/membership objects, ridden out by bounded retries
+        rdzv = RetryingBackend(
+            FlakyBackend(rdzv,
+                         seed=int(flaky_cfg.get("seed", 0))
+                         + sum(wid.encode()) % 97,
+                         transient_rate=float(
+                             flaky_cfg.get("transient_rate", 0.2)),
+                         match=flaky_cfg.get("match")),
+            max_retries=6, base_backoff_s=0.01, max_backoff_s=0.2)
+    cm = CheckpointManager(
+        storage=LocalFSBackend(os.path.join(CFG["store_dir"], "ckpt")),
+        sharded=True, async_write=False,
+        barrier_timeout_s=float(CFG.get("barrier_timeout_s", 10.0)))
+
+    kill = (CFG.get("kill") or {}).get(wid)
+    if kill and kill.get("first_attempt_only") and _ATTEMPT > 1:
+        kill = None  # a respawned attempt runs clean
+    step_sleep_s = float(CFG.get("step_sleep_s", 0.0))
+
+    def on_generation(model, membership, rank, world):
+        with open(os.path.join(
+                out_dir, f"gen-{wid}-{membership.generation}.json"),
+                "w") as f:
+            json.dump({
+                "worker": wid, "generation": membership.generation,
+                "members": membership.members, "rank": rank, "world": world,
+                "restored_from": getattr(model, "_restored_from", None)
+                and model._restored_from.path,
+                "epoch": model.epoch,
+                "state_sha": shd.state_sha(model),
+            }, f)
+        if kill:
+            model.add_listener(FaultInjector(
+                kill_at_step=kill.get("at_step"),
+                kill_at_epoch=kill.get("at_epoch"),
+                kill_mode="process"))
+        if step_sleep_s:
+            import time as _time
+
+            class _Pace:  # host-side pacing so joiners can land mid-run
+                def iteration_done(self, m, i, e):
+                    _time.sleep(step_sleep_s)
+
+                def on_epoch_start(self, m):
+                    pass
+
+                def on_epoch_end(self, m):
+                    pass
+            model.add_listener(_Pace())
+
+    worker = ElasticWorker(
+        store=rdzv, worker_id=wid, checkpoint_manager=cm,
+        num_workers=int(CFG["num_workers"]),
+        lease_ttl_s=float(CFG.get("lease_ttl_s", 3.0)),
+        join_timeout_s=float(CFG.get("join_timeout_s", 90.0)),
+        poll_s=float(CFG.get("poll_s", 0.15)),
+        scaledown_grace_s=float(CFG.get("scaledown_grace_s", 5.0)),
+        collective_timeout_s=float(CFG.get("collective_timeout_s", 8.0)),
+        init_timeout_s=int(CFG.get("init_timeout_s", 30)),
+        on_generation=on_generation)
+
+    try:
+        summary = worker.run(_model_factory, _global_batches(),
+                             num_epochs=int(CFG["num_epochs"]))
+    except ElasticRestartRequired as e:
+        print(f"{wid}: elastic restart required: {e}", flush=True)
+        os._exit(ELASTIC_RESTART_EXIT)
+
+    with open(os.path.join(out_dir, f"done-{wid}.json"), "w") as f:
+        json.dump({
+            "worker": wid,
+            "epochs": summary.model.epoch,
+            "iteration": summary.model.iteration,
+            "state_sha": shd.state_sha(summary.model),
+            "evictions": summary.evictions,
+            "generations": [{
+                "generation": g.generation, "world": g.world_size,
+                "rank": g.rank, "epochs": g.epochs, "ended": g.ended,
+                "restored_from": g.restored_from,
+            } for g in summary.generations],
+        }, f)
+    print(f"{wid}-done", flush=True)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception:
+        import traceback
+        traceback.print_exc()
+        sys.stdout.flush()
+        os._exit(1)
