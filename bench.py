@@ -30,6 +30,8 @@ import time
 
 import numpy as np
 
+from deeplearning4j_tpu.obs import Stopwatch
+
 QUICK = os.environ.get("BENCH_QUICK") == "1"
 
 # The axon tunnel's host-side conditions swing measured throughput by
@@ -372,9 +374,9 @@ def bench_word2vec():
     total_words = model.vocab.total_word_occurrences
 
     def timed():
-        t0 = time.perf_counter()  # lint: disable=DLT003 (fit() syncs internally: vocab/vectors land on host)
-        model.fit(sents)
-        return time.perf_counter() - t0
+        with Stopwatch() as sw:  # fit() syncs internally: vocab/vectors land on host
+            model.fit(sents)
+        return sw.seconds
 
     dt = _best_of(timed)
     emit("word2vec_sgns_train_words_per_sec_per_chip", total_words / dt,
@@ -438,20 +440,20 @@ def bench_serving():
         for i in range(reqs_per_client):
             x = r.standard_normal(
                 (sizes[(cid + i) % len(sizes)], n_features)).astype(np.float32)
-            t = time.perf_counter()  # lint: disable=DLT003 (output_batched blocks on the observable, returns a host array)
-            pi.output_batched(x)
+            sw = Stopwatch().start()
+            sw.stop(pi.output_batched(x))  # blocks on the observable's host array
             with lat_lock:
-                lat.append(time.perf_counter() - t)
+                lat.append(sw.seconds)
 
     def timed():
-        t = time.perf_counter()  # lint: disable=DLT003 (joins client threads; every client is synced)
+        sw = Stopwatch().start()  # joins client threads; every client is synced
         threads = [threading.Thread(target=client, args=(c,))
                    for c in range(n_clients)]
         for th in threads:
             th.start()
         for th in threads:
             th.join()
-        return time.perf_counter() - t
+        return sw.stop()
 
     dt = _best_of(timed)
     n_requests = n_clients * reqs_per_client

@@ -42,6 +42,18 @@ Rules (waivable per line with ``# lint: disable=DLT00X`` or per file with
   that dies at restore time. Narrow the handler, log it, or waive inline
   like DLT003.
 
+- **DLT007 metric-registration**: metrics belong in the ``obs``
+  MetricsRegistry **with units and help text** — two checks: (a) a
+  ``.counter(...)`` / ``.gauge(...)`` / ``.histogram(...)`` call on a
+  registry-named receiver (last segment containing ``registry``, or
+  ``reg`` / ``metrics``) must pass both ``unit=`` and ``help=`` (empty
+  literals count as missing); (b) no NEW bare counter dicts — assigning
+  ``{}`` / ``dict()`` / ``Counter()`` / ``defaultdict(...)`` to a name
+  (lowercased) equal to ``counters`` or ending ``_counters``. An
+  unlabeled number on a dashboard is a guess. Pre-obs surfaces
+  (``CompileWatch``, ``TrainingStats``) are absorbed into the registry by
+  ``obs.absorb_*`` and carry inline waivers.
+
 Adding a rule: write a ``_rule_xxx(tree, src, path) -> List[LintViolation]``
 function and register it in ``_RULES``; tests in ``tests/test_lint.py``
 seed a fixture violating the rule and assert it fires.
@@ -453,6 +465,76 @@ def _rule_swallowed_storage_error(tree, src, path) -> List[LintViolation]:
     return out
 
 
+# ------------------------------------------------------------------ DLT007
+_METRIC_METHODS = ("counter", "gauge", "histogram")
+_COUNTER_DICT_CTORS = ("dict", "Counter", "defaultdict", "OrderedDict")
+
+
+def _is_registry_receiver(recv: Optional[str]) -> bool:
+    if not recv:
+        return False
+    last = recv.split(".")[-1].lower()
+    return "registry" in last or last in ("reg", "metrics")
+
+
+def _rule_metric_registration(tree, src, path) -> List[LintViolation]:
+    out: List[LintViolation] = []
+    for node in ast.walk(tree):
+        # (a) registry instrument calls must carry unit + help
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _METRIC_METHODS and \
+                _is_registry_receiver(_dotted(node.func.value)):
+            # signature: (name, unit, help, ...) — positionals count
+            present = {("name", "unit", "help")[i]
+                       for i in range(min(3, len(node.args)))}
+            empty = set()
+            for i, a in enumerate(node.args[:3]):
+                if isinstance(a, ast.Constant) and a.value == "":
+                    empty.add(("name", "unit", "help")[i])
+            for kw in node.keywords:
+                if kw.arg in ("unit", "help"):
+                    present.add(kw.arg)
+                    if isinstance(kw.value, ast.Constant) and \
+                            kw.value.value == "":
+                        empty.add(kw.arg)
+            missing = sorted(({"unit", "help"} - present) | empty)
+            if missing:
+                out.append(LintViolation(
+                    path, node.lineno, "DLT007",
+                    f"metric registered via .{node.func.attr}(...) without "
+                    f"{' and '.join(missing)} — every metric needs a unit "
+                    "and help text (an unlabeled number on a dashboard is "
+                    "a guess)"))
+            continue
+        # (b) bare counter dicts
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        bare = isinstance(value, ast.Dict) and not value.keys
+        if isinstance(value, ast.Call):
+            tail = (_dotted(value.func) or "").rsplit(".", 1)[-1]
+            bare = tail in _COUNTER_DICT_CTORS and not value.args \
+                and not value.keywords or tail == "defaultdict"
+        if not bare:
+            continue
+        for t in targets:
+            name = (t.attr if isinstance(t, ast.Attribute)
+                    else t.id if isinstance(t, ast.Name) else "")
+            low = name.lower()
+            if low == "counters" or low.endswith("_counters"):
+                out.append(LintViolation(
+                    path, node.lineno, "DLT007",
+                    f"bare counter dict '{name}' — register metrics in an "
+                    "obs.MetricsRegistry with units and help text instead "
+                    "(or absorb the surface via obs.absorb_* and waive "
+                    "inline)"))
+    return out
+
+
 # ----------------------------------------------------------------- harness
 _RULES = (
     _rule_module_level_jnp,
@@ -461,6 +543,7 @@ _RULES = (
     _rule_lock_order,
     _rule_serving_bn_fold,
     _rule_swallowed_storage_error,
+    _rule_metric_registration,
 )
 
 

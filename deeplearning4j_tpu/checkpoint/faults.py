@@ -30,6 +30,7 @@ replay exactly:
 
 from __future__ import annotations
 
+import logging
 import os
 import random
 import threading
@@ -38,6 +39,8 @@ from typing import List, Optional
 
 from deeplearning4j_tpu.checkpoint.storage import (
     StorageBackend, TransientStorageError)
+
+log = logging.getLogger(__name__)
 
 
 class SimulatedCrash(RuntimeError):
@@ -112,6 +115,15 @@ class FaultInjector:
     def _kill(self, why: str):
         self.fired = True
         self.kills += 1
+        # flush the crash flight recorder BEFORE dying — for
+        # kill_mode="process" the SIGKILL leaves no other chance, and the
+        # dump in storage is what the supervisor's post-mortem reads
+        try:
+            from deeplearning4j_tpu.obs.flight import flush_flight_recorder
+            flush_flight_recorder(f"fault injection: {why}")
+        except Exception:
+            log.exception("flight-recorder flush before injected kill "
+                          "failed")
         if self.kill_mode == "process":
             # REAL death: no exception anyone could catch, no cleanup —
             # exactly what a preemption does to a worker

@@ -199,6 +199,26 @@ class CheckpointManager:
         self.saves_requested = 0
         self.saves_fenced = 0
         self.saves_committed = 0
+        # obs: commit/restore durations + bytes land in the process-wide
+        # registry (the counters above are absorbed at scrape time)
+        from deeplearning4j_tpu.obs.registry import (
+            absorb_checkpoint_manager, get_registry)
+        reg = get_registry()
+        self._m_commit_ms = reg.histogram(
+            "checkpoint_commit_ms", unit="ms",
+            help="wall time of one checkpoint payload write + journal "
+                 "commit (storage put + manifest)")
+        self._m_bytes_written = reg.counter(
+            "checkpoint_bytes_written_total", unit="bytes",
+            help="checkpoint payload bytes committed to storage")
+        self._m_restore_ms = reg.histogram(
+            "checkpoint_restore_ms", unit="ms",
+            help="wall time of one checkpoint restore (fetch + verify + "
+                 "deserialize)")
+        self._m_bytes_restored = reg.counter(
+            "checkpoint_bytes_restored_total", unit="bytes",
+            help="checkpoint payload bytes read back during restores")
+        absorb_checkpoint_manager(reg, self)
 
     def _entry_from_object(self, filename: str) -> Optional[dict]:
         """Reconstruct a full journal entry from a checkpoint zip's own
@@ -401,6 +421,7 @@ class CheckpointManager:
         import jax
         from deeplearning4j_tpu.checkpoint import sharded as shd
         pi, pc = jax.process_index(), jax.process_count()
+        t0 = time.perf_counter()
         self._seq += 1  # every host: shard names must agree fleet-wide
         snap = shd.shard_snapshot(model)
         if not self.save_updater:
@@ -414,7 +435,9 @@ class CheckpointManager:
         base = f"ckpt-{snap['iteration']:010d}-{self._seq:05d}"
         shard_name = shd.shard_object_name(base, pi, pc)
         self.saves_requested += 1
-        self._storage.put(shard_name, shd.shard_zip_bytes(snap, extra))
+        shard_bytes = shd.shard_zip_bytes(snap, extra)
+        self._storage.put(shard_name, shard_bytes)
+        self._m_bytes_written.inc(len(shard_bytes))
         self._barrier("sharded payloads durable")
         if pi == 0:
             shards = []
@@ -462,10 +485,13 @@ class CheckpointManager:
                 raise
             self.saves_committed += 1
         self._barrier("sharded journal")
+        self._m_commit_ms.observe((time.perf_counter() - t0) * 1000.0)
         return f"{base}.sharded" if pi == 0 else None
 
     def _write_and_commit(self, snap: dict, extra: dict, filename: str):
+        from deeplearning4j_tpu.obs.trace import get_tracer
         from deeplearning4j_tpu.utils.serialization import checkpoint_zip_bytes
+        t0 = time.perf_counter()
         data = checkpoint_zip_bytes(snap, extra)
         sha = hashlib.sha256(data).hexdigest()
         # fsync_directory deferred to the manifest write below (same dir):
@@ -501,6 +527,12 @@ class CheckpointManager:
                             "(%s: %s)", filename, type(de).__name__, de)
             raise
         self.saves_committed += 1
+        commit_ms = (time.perf_counter() - t0) * 1000.0
+        self._m_commit_ms.observe(commit_ms)
+        self._m_bytes_written.inc(len(data))
+        get_tracer().event("checkpoint.commit", file=filename,
+                           step=snap.get("iteration"), bytes=len(data),
+                           ms=round(commit_ms, 2))
 
     def _best_entry(self, entries: List[dict],
                     direction: Optional[str] = None) -> Optional[dict]:
@@ -618,6 +650,7 @@ class CheckpointManager:
     def _try_restore(self, entry: dict, load_updater: bool,
                      arm_resume: bool):
         import io
+        t0 = time.perf_counter()
         if entry.get("sharded"):
             # shard-set entry: fetch + sha-verify every shard, reassemble
             # the full state (works on ANY restoring world size — the N→M
@@ -652,6 +685,8 @@ class CheckpointManager:
         # arming it there would make the user's next fine-tune fit()
         # silently reinterpret num_epochs / skip unrelated batches
         model._resume_state = info if arm_resume else None
+        self._m_restore_ms.observe((time.perf_counter() - t0) * 1000.0)
+        self._m_bytes_restored.inc(int(entry.get("size", 0) or 0))
         return model
 
     def restore_latest(self, load_updater: bool = True):

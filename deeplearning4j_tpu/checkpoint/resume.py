@@ -75,6 +75,10 @@ class CrashRecord:
     restored_step: Optional[int]     # checkpoint step recovery resumed from
     restored_epoch: Optional[int]
     backoff_s: float
+    # the victim's last seconds: one-liner summaries of the newest crash
+    # flight-recorder ring entries (obs/flight.py) — in-process tail for
+    # train_until, the flushed storage dump for train_until_process
+    flight_tail: Optional[List[str]] = None
 
 
 @dataclasses.dataclass
@@ -211,6 +215,17 @@ def train_until(model, data, num_epochs: int, checkpoint_manager,
                     error=str(e), crashed_at_step=crashed_at,
                     restored_step=None, restored_epoch=None,
                     backoff_s=delay)
+                # same process, so the flight ring is directly readable:
+                # attach what the victim was doing when it crashed
+                try:
+                    from deeplearning4j_tpu.obs.flight import (
+                        get_flight_recorder)
+                    fr = get_flight_recorder()
+                    if fr is not None and fr.recorded:
+                        crash_rec.flight_tail = fr.tail_summary(8)
+                except Exception as fe:
+                    log.debug("could not attach flight tail (%s: %s)",
+                              type(fe).__name__, fe)
                 crashes.append(crash_rec)
                 # a failed RESTORE is itself recoverable (a transient
                 # storage outage makes restore_latest raise or fall all

@@ -128,6 +128,7 @@ def train_until_process(worker_argv: Union[Sequence[str], Callable],
     crashes: List[ProcessCrashRecord] = []
     restarts = 0
     t0 = time.monotonic()
+    t0_wall = time.time()
 
     def argv_for(w: _Worker) -> List[str]:
         if callable(worker_argv):
@@ -186,11 +187,54 @@ def train_until_process(worker_argv: Union[Sequence[str], Callable],
         log.error("train_until_process giving up: %s — %s", message, s)
         raise RestartBudgetExceeded(message, s)
 
+    attached_dumps: set = set()
+
+    def flight_tail() -> Optional[List[str]]:
+        """The victim's last seconds, read back ACROSS the process
+        boundary: the worker's crash flight recorder (obs/flight.py)
+        flushed its ring into the checkpoint store before dying. Dumps
+        predating this run are ignored, and each dump is attached to at
+        most ONE crash record, oldest-unattached first — crashes are
+        recorded in observation order, so two workers dying in the same
+        monitor window each get their own victim's dump instead of both
+        showing the newest one (best-effort: the supervisor cannot map
+        its slot index to the worker's self-chosen recorder id).
+        Watchdog-timeout dumps sort LAST: the elastic membership-bump
+        escalation can flush one from a worker that then keeps running,
+        so a dump flushed by an actual death always wins and a watchdog
+        dump is attached only when nothing else is fresh (the non-elastic
+        path, where the timeout did kill the attempt)."""
+        if checkpoint_manager is None:
+            return None
+        store = getattr(checkpoint_manager, "_storage", None)
+        if store is None:
+            return None
+        try:
+            from deeplearning4j_tpu.obs.flight import (dump_tail_summary,
+                                                       read_dumps)
+            fresh = [d for d in read_dumps(store)
+                     if d.get("time", 0.0) >= t0_wall
+                     and (d.get("worker_id"), d.get("time"))
+                     not in attached_dumps]
+            if fresh:
+                fresh.sort(key=lambda d: (
+                    str(d.get("reason", "")).startswith("watchdog timeout"),
+                    d.get("time", 0.0)))
+                dump = fresh[0]  # oldest unattached non-diagnostic first
+                attached_dumps.add((dump.get("worker_id"),
+                                    dump.get("time")))
+                return dump_tail_summary(dump)
+        except Exception as e:
+            log.warning("could not read flight dump (%s: %s)",
+                        type(e).__name__, e)
+        return None
+
     def record(w: _Worker, kind: str, detail: str, backoff: float):
         crashes.append(ProcessCrashRecord(
             attempt=len(crashes) + 1, error_type=kind, error=detail,
             crashed_at_step=store_step(), restored_step=None,
-            restored_epoch=None, backoff_s=backoff, worker=w.index))
+            restored_epoch=None, backoff_s=backoff, worker=w.index,
+            flight_tail=flight_tail()))
 
     def schedule_respawn(w: _Worker, kind: str, detail: str):
         nonlocal restarts

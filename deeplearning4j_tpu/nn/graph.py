@@ -485,6 +485,8 @@ class ComputationGraph:
             resume_plan, skip_consumed_batches)
         epochs_to_run, skip = resume_plan(self, num_epochs)
         step = self._get_jitted("train")
+        from deeplearning4j_tpu.obs.trace import get_tracer
+        tracer = get_tracer()
         for _ in range(epochs_to_run):
             # epoch-boundary listener hooks: MLN parity (epoch-scoped
             # listeners — and the chaos harness's epoch-boundary fault
@@ -497,11 +499,21 @@ class ComputationGraph:
             stream = skip_consumed_batches(data, skip)
             if prefetch_cls is not None:
                 stream = prefetch_cls(stream)
+            # data-wait / host / device phase spans: same breakdown as
+            # multilayer.py fit (host-side only; see obs/trace.py)
+            stream = tracer.wrap_iter(stream, "train.data_wait")
             bi = skip
             for ds in stream:
                 bi += 1
                 mds = MultiDataSet.from_dataset(ds) if isinstance(ds, DataSet) else ds
-                self._fit_batch(step, mds)
+                if tracer.enabled:
+                    with tracer.span("train.step_host", step=self.iteration):
+                        self._fit_batch(step, mds)
+                    with tracer.span("train.step_device",
+                                     step=self.iteration - 1):
+                        jax.block_until_ready(self._score)
+                else:
+                    self._fit_batch(step, mds)
                 if checkpoint_manager is not None:
                     checkpoint_manager.step_end(self, batch_in_epoch=bi)
             skip = 0

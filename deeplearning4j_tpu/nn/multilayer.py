@@ -666,6 +666,8 @@ class MultiLayerNetwork:
         if prefetch:
             from deeplearning4j_tpu.perf.prefetch import DevicePrefetchIterator
             prefetch_cls = DevicePrefetchIterator
+        from deeplearning4j_tpu.obs.trace import get_tracer
+        tracer = get_tracer()
         for _ in range(epochs_to_run):
             for listener in self.listeners:
                 listener.on_epoch_start(self)
@@ -676,10 +678,24 @@ class MultiLayerNetwork:
             stream = skip_consumed_batches(data, skip)
             if prefetch_cls is not None:
                 stream = prefetch_cls(stream)
+            # data-wait spans sit ABOVE prefetch: they measure what the
+            # step loop actually waits for, which prefetch exists to hide
+            stream = tracer.wrap_iter(stream, "train.data_wait")
             bi = skip
             for ds in stream:
                 bi += 1
-                self._fit_batch(train_step, ds)
+                if tracer.enabled:
+                    # host phase = trace/dispatch + listeners (async
+                    # dispatch returns immediately); device phase = the
+                    # remaining on-device time, exposed by a host-side
+                    # block_until_ready — spans never enter traced code
+                    with tracer.span("train.step_host", step=self.iteration):
+                        self._fit_batch(train_step, ds)
+                    with tracer.span("train.step_device",
+                                     step=self.iteration - 1):
+                        jax.block_until_ready(self._score)
+                else:
+                    self._fit_batch(train_step, ds)
                 if checkpoint_manager is not None:
                     checkpoint_manager.step_end(self, batch_in_epoch=bi)
             skip = 0

@@ -696,8 +696,45 @@ class ElasticWorker:
                                      scaledown_grace_s=scaledown_grace_s,
                                      advertise_host=advertise_host)
         self.runtime = ElasticRuntime(init_timeout_s=init_timeout_s)
+        # obs: generation id / world size are THE labels every elastic
+        # post-mortem starts from; the transition pause (generation end →
+        # training again: re-rendezvous + runtime re-init + restore) is
+        # the availability cost of a membership change
+        from deeplearning4j_tpu.obs.registry import get_registry
+        reg = get_registry()
+        self._m_generation = reg.gauge(
+            "elastic_generation", unit="generation",
+            help="membership generation this worker is training in")
+        self._m_world_size = reg.gauge(
+            "elastic_world_size", unit="workers",
+            help="world size of the current membership generation")
+        self._m_generations = reg.counter(
+            "elastic_generations_total", unit="generations",
+            help="membership generations this worker joined")
+        self._m_transition_pause = reg.histogram(
+            "elastic_transition_pause_ms", unit="ms",
+            help="membership-transition pause: generation end to training "
+                 "again (rendezvous + runtime re-init + restore)")
+        self._m_evictions = reg.gauge(
+            "elastic_evictions", unit="evictions",
+            help="times this worker was evicted and had to rejoin")
 
     # ------------------------------------------------------------ internals
+    def _obs_event(self, name: str, **attrs):
+        """Lifecycle breadcrumb into the telemetry pipeline: through the
+        tracer when tracing is on (reaches every sink, flight ring
+        included), straight into the flight ring otherwise — generation
+        boundaries must be in the crash ring even with tracing off."""
+        from deeplearning4j_tpu.obs.flight import get_flight_recorder
+        from deeplearning4j_tpu.obs.trace import get_tracer
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.event(name, **attrs)
+            return
+        fr = get_flight_recorder()
+        if fr is not None:
+            fr.event(name, **attrs)
+
     def _assert_current(self, m: Membership):
         """Checkpoint commit fence: refuse to journal from a superseded
         generation (the split-brain guard for an evicted-but-alive
@@ -776,6 +813,7 @@ class ElasticWorker:
                         f"exceeded max_generations={self.max_generations} "
                         "— the membership is churning faster than "
                         "training progresses")
+                t_rdv = time.monotonic()
                 m = self.rendezvous.propose_or_await(
                     want, expected=(self.num_workers if first else None),
                     reason="initial quorum" if first else "membership change")
@@ -784,6 +822,13 @@ class ElasticWorker:
                 rec = GenerationRecord(generation=m.generation,
                                        world_size=world, rank=rank)
                 gens.append(rec)
+                self._m_generation.set(m.generation)
+                self._m_world_size.set(world)
+                self._m_generations.inc()
+                self._m_evictions.set(self.rendezvous.evictions)
+                self._obs_event("elastic.generation_start",
+                                generation=m.generation, world=world,
+                                rank=rank, reason=m.reason)
                 clean_boundary = False
                 t0 = time.monotonic()
                 try:
@@ -831,6 +876,17 @@ class ElasticWorker:
                     local = self._data_for(data, rank, world)
                     if self.on_generation is not None:
                         self.on_generation(model, m, rank, world)
+                    if m.generation > 1:
+                        # generation 1 is the initial quorum, not a
+                        # transition; everything later — in-process
+                        # re-shard OR a respawned worker rejoining — pays
+                        # this pause before training resumes
+                        pause_ms = (time.monotonic() - t_rdv) * 1000.0
+                        self._m_transition_pause.observe(pause_ms)
+                        self._obs_event("elastic.transition_pause",
+                                        generation=m.generation,
+                                        world=world,
+                                        pause_ms=round(pause_ms, 2))
                     while model.epoch < num_epochs:
                         # exactly ONE epoch per fit call: num_epochs is
                         # the run TOTAL when a restored model carries a
@@ -912,6 +968,9 @@ class ElasticWorker:
                         raise
                 finally:
                     rec.wall_s = time.monotonic() - t0
+                    self._obs_event("elastic.generation_end",
+                                    generation=m.generation,
+                                    epochs=rec.epochs, reason=rec.ended)
                 # a synchronized boundary exit tears down cooperatively
                 # (real shutdown barrier, gloo contexts destroyed);
                 # crash/hang exits leak the runtime instead
@@ -919,6 +978,13 @@ class ElasticWorker:
                 cur = self.rendezvous.current()
                 want = max(m.generation,
                            cur.generation if cur else 0) + 1
+        except ElasticRestartRequired as e:
+            # the process is about to exit ELASTIC_RESTART_EXIT — this is
+            # the flight recorder's moment: the ring holds the victim's
+            # last seconds and nothing after this write survives
+            from deeplearning4j_tpu.obs.flight import flush_flight_recorder
+            flush_flight_recorder(f"ELASTIC_RESTART_EXIT: {e}")
+            raise
         finally:
             self.cm.commit_guard = None
             self.cm.fence(None)

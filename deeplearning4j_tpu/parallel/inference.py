@@ -154,6 +154,32 @@ class ParallelInference:
         self.swap_poll_errors = 0
         self.current_checkpoint_step = (None if restored_from is None
                                         else int(restored_from.step))
+        # obs: hot-path instruments are shared process-wide (the registry
+        # is the source of truth for the Prometheus scrape); stats() is
+        # additionally absorbed at collect time so its sections (hot-swap,
+        # buckets, attention) need no per-dispatch writes
+        from deeplearning4j_tpu.obs.registry import (absorb_inference_stats,
+                                                     get_registry)
+        from deeplearning4j_tpu.obs.trace import get_tracer
+        # configure_tracer mutates the global Tracer in place, so the handle
+        # stays valid; caching it keeps the global lookup off the dispatch
+        # hot path (the fit loops hoist it the same way)
+        self._tracer = get_tracer()
+        reg = get_registry()
+        self._m_queue_depth = reg.gauge(
+            "serving_queue_depth", unit="requests",
+            help="requests waiting in the batching queue after a coalesce")
+        self._m_occupancy = reg.histogram(
+            "serving_batch_occupancy", unit="requests",
+            help="coalesced requests per dispatched batch (batch_limit is "
+                 "the ceiling)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+        self._m_pad_waste = reg.histogram(
+            "serving_pad_waste_rows", unit="rows",
+            help="padding rows added per dispatch to reach the bucket "
+                 "target (bucket ladder pad waste)",
+            buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
+        absorb_inference_stats(reg, self)
         if checkpoint_manager is not None:
             self.start_hot_swap(checkpoint_manager,
                                 poll_secs=checkpoint_poll_secs)
@@ -176,6 +202,7 @@ class ParallelInference:
             self.row_sizes.append(n_rows)
             if target not in self._warmed:
                 self.unwarmed_dispatches += 1
+        self._m_pad_waste.observe(max(0, target - n_rows))
 
     # ------------------------------------------------------------ sync path
     def _dispatch(self, arr, target: int, record: bool = True):
@@ -194,8 +221,10 @@ class ParallelInference:
             # _model_lock: a checkpoint hot-swap can never land mid-batch —
             # it waits here for the in-flight dispatch, and the very next
             # dispatch serves the new params
-            with self._model_lock:
-                out = self.model.output(arr)
+            with self._tracer.span("serving.dispatch", rows=n,
+                                   target=target):
+                with self._model_lock:
+                    out = self.model.output(arr)
             return out[:n] if target != n else out
 
     def output(self, x) -> np.ndarray:
@@ -527,6 +556,10 @@ class ParallelInference:
             items = self._collect()
             if not items:
                 continue
+            # what's STILL queued after this coalesce = the backlog a new
+            # request joins; occupancy tells whether batching is working
+            self._m_queue_depth.set(self._q.qsize())
+            self._m_occupancy.observe(len(items))
             xs = [i[0] for i in items]
             sizes = [len(x) for x in xs]
             with self._inflight_lock:

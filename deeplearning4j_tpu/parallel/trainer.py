@@ -78,6 +78,12 @@ class ParallelWrapper:
         # phase timing (reference CommonSparkTrainingStats; enable with
         # collect_stats=True, read via .stats)
         self.stats = TrainingStats() if collect_stats else None
+        if self.stats is not None:
+            # obs: absorbed at scrape time like ParallelInference.stats(),
+            # so /metrics carries the phase breakdown with no per-step writes
+            from deeplearning4j_tpu.obs.registry import (get_registry,
+                                                         watch_training_stats)
+            watch_training_stats(get_registry(), self.stats)
 
     # ---- parameter placement ----
     def _place_params(self):
@@ -466,6 +472,8 @@ class ClusterTrainer(ParallelWrapper):
             prefetch_cls = DevicePrefetchIterator
         from deeplearning4j_tpu.checkpoint.manager import (
             resume_plan, skip_consumed_batches)
+        from deeplearning4j_tpu.obs.trace import get_tracer
+        tracer = get_tracer()
         epochs_to_run, skip = resume_plan(self.model, num_epochs)
         step_no = 0
         with self.mesh:
@@ -482,6 +490,10 @@ class ClusterTrainer(ParallelWrapper):
                 if prefetch_cls is not None:
                     stream = prefetch_cls(stream,
                                           place_fn=self._stage_local_batch)
+                # same phase spans as MLN/graph fit (obs/trace.py): the
+                # elastic worker trains through THIS loop, so its crash
+                # ring / event log carry the per-step breakdown too
+                stream = tracer.wrap_iter(stream, "train.data_wait")
                 for ds in stream:
                     # _model_fit_batch, not model.fit: per-epoch hooks and
                     # the epoch counter must fire once per EPOCH, not once
@@ -503,14 +515,38 @@ class ClusterTrainer(ParallelWrapper):
                                 self._model_fit_batch(sharded)
                             self.stats.examples += n_local
                             self.stats.minibatches += 1
-                    if wd is None:
-                        one_step()
+                    def guarded_step():
+                        if wd is None:
+                            one_step()
+                        else:
+                            # the dispatch itself can block synchronously
+                            # on a dead peer's collective rendezvous, so
+                            # the deadline must wrap the whole call, not
+                            # just a later sync
+                            wd.call(one_step,
+                                    what=f"cluster step {step_no + 1} "
+                                         "dispatch")
+                    if tracer.enabled:
+                        # both spans run inside ONE watchdog call so the
+                        # traced path pays the same single worker thread
+                        # per step as the untraced one, and the device
+                        # sync still sits under the deadline: a hung
+                        # collective raises CollectiveTimeoutError (the
+                        # elastic membership-bump escalation) instead of
+                        # hanging the tracing span forever
+                        def traced_step(n=step_no):
+                            with tracer.span("train.step_host", step=n):
+                                one_step()
+                            with tracer.span("train.step_device", step=n):
+                                jax.block_until_ready(self.model._score)
+                        if wd is None:
+                            traced_step()
+                        else:
+                            wd.call(traced_step,
+                                    what=f"cluster step {step_no + 1} "
+                                         "dispatch+sync")
                     else:
-                        # the dispatch itself can block synchronously on a
-                        # dead peer's collective rendezvous, so the deadline
-                        # must wrap the whole call, not just a later sync
-                        wd.call(one_step,
-                                what=f"cluster step {step_no + 1} dispatch")
+                        guarded_step()
                     step_no += 1
                     seen += 1
                     if wd is not None and step_no % max(1, watchdog_every) == 0:

@@ -66,6 +66,21 @@ class CollectiveWatchdog:
     def _expire(self, what: str, elapsed: float):
         msg = self._diagnose(what, elapsed)
         log.error(msg)
+        # a hung collective is a post-mortem moment: put the diagnostic in
+        # the crash ring and flush it NOW — with abort=True nothing after
+        # this line runs, and even the raise path may end in process death
+        try:
+            from deeplearning4j_tpu.obs.flight import (flush_flight_recorder,
+                                                       get_flight_recorder)
+            fr = get_flight_recorder()
+            if fr is not None:
+                fr.event("watchdog.timeout", what=what,
+                         elapsed_s=round(elapsed, 2),
+                         timeout_s=self.timeout_s)
+            flush_flight_recorder(f"watchdog timeout: {what}")
+        except Exception:
+            log.exception("flight-recorder flush on watchdog timeout "
+                          "failed")
         if self.on_timeout is not None:
             try:
                 self.on_timeout(msg)

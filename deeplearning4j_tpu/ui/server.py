@@ -187,9 +187,10 @@ function tile(label, value) {
   return `<div class="tile"><div class="v">${value}</div><div class="l">${label}</div></div>`;
 }
 async function render(resetParam) {
-  const [stat, updates] = await Promise.all([
+  const [stat, updates, obs] = await Promise.all([
     j(`/api/static?session=${encodeURIComponent(CUR)}`),
-    j(`/api/updates?session=${encodeURIComponent(CUR)}`)]);
+    j(`/api/updates?session=${encodeURIComponent(CUR)}`),
+    j("/api/obs").catch(() => ({}))]);
   const last = updates[updates.length-1] || {};
   $("subtitle").textContent = stat && stat.model ?
     `${stat.model.class} — ${fmt(stat.model.num_params)} params — ${stat.hardware.device_kind} ×${stat.hardware.device_count}` : CUR;
@@ -203,12 +204,23 @@ async function render(resetParam) {
   const P = psel.value || pnames[0];
   const iters = updates.map(u => u.iteration);
   const perf = last.performance || {};
+  // obs tiles: registry-backed telemetry (hot-swap + elastic fleet state)
+  // rendered only when the process actually reports it
+  const obsVal = n => obs && obs[n] ? obs[n].value : undefined;
+  let obsTiles = "";
+  if (obsVal("serving_hot_swap_swaps") !== undefined)
+    obsTiles += tile("hot swaps", fmt(obsVal("serving_hot_swap_swaps")));
+  if (obsVal("serving_hot_swap_poll_errors") !== undefined)
+    obsTiles += tile("swap poll errors", fmt(obsVal("serving_hot_swap_poll_errors")));
+  if (obsVal("elastic_generation") !== undefined)
+    obsTiles += tile("elastic generation", fmt(obsVal("elastic_generation")));
   $("tiles").innerHTML =
     tile("last score", fmt(last.score)) +
     tile("iteration", fmt(last.iteration ?? 0)) +
     tile("examples/sec", fmt(perf.examples_per_second || 0)) +
     tile("total examples", fmt(perf.total_examples || 0)) +
-    tile("runtime", fmt((perf.total_runtime_ms || 0)/1000) + "s");
+    tile("runtime", fmt((perf.total_runtime_ms || 0)/1000) + "s") +
+    obsTiles;
   lineChart($("score"), iters, updates.map(u => u.score ?? NaN));
   lineChart($("ratio"), iters,
     updates.map(u => u.update_ratios && u.update_ratios[P] > 0 ? Math.log10(u.update_ratios[P]) : NaN));
@@ -402,6 +414,18 @@ class _Handler(BaseHTTPRequestHandler):
             # reference ConvolutionalListenerModule.java:32 /activations
             self._send(200, _ACTIVATIONS_HTML.encode(),
                        "text/html; charset=utf-8")
+        elif url.path == "/metrics":
+            # Prometheus exposition of the process-wide MetricsRegistry
+            # (obs/): scrape target for the fleet — the registry absorbs
+            # CompileWatch, serving stats, checkpoint + elastic telemetry
+            from deeplearning4j_tpu.obs.exporters import prometheus_text
+            self._send(200, prometheus_text().encode(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif url.path == "/api/obs":
+            # the same registry as JSON — what the dashboard's obs tiles
+            # (hot-swap swaps / poll errors, elastic generation) read
+            from deeplearning4j_tpu.obs.registry import get_registry
+            self._json(get_registry().as_dict())
         elif url.path == "/api/sessions":
             self._json(st.list_session_ids() if st else [])
         elif url.path == "/api/static":

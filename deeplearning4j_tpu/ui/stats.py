@@ -229,6 +229,11 @@ class StatsListener(TrainingListener):
         record["stats_collection_duration_ms"] = \
             (time.perf_counter() - t0) * 1000.0
         self.storage.put_update(record)
+        # one source, two surfaces: the same record that feeds the
+        # dashboard updates the MetricsRegistry (score / throughput
+        # gauges) and flows into the trace/flight event pipeline
+        from deeplearning4j_tpu.obs.registry import publish_stats_update
+        publish_stats_update(record)
         self._last_params = flat
         self._last_report_time = now
         self._examples_since = 0

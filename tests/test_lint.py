@@ -360,6 +360,82 @@ class TestSwallowedStorageError:
                      path="deeplearning4j_tpu/checkpoint/thing.py") == []
 
 
+class TestMetricRegistration:
+    def test_fires_on_missing_unit_and_help(self):
+        vs = _lint("""
+            from deeplearning4j_tpu.obs import get_registry
+            def setup():
+                registry = get_registry()
+                return registry.counter("requests_total")
+        """)
+        assert _rules(vs) == ["DLT007"]
+        assert "unit and help" in vs[0].message
+
+    def test_fires_on_missing_help_only(self):
+        vs = _lint("""
+            def setup(reg):
+                return reg.gauge("depth", unit="requests")
+        """)
+        assert _rules(vs) == ["DLT007"]
+        assert "help" in vs[0].message and "unit" not in \
+            vs[0].message.split("—")[0].replace("without help", "")
+
+    def test_empty_literal_unit_counts_as_missing(self):
+        vs = _lint("""
+            def setup(registry):
+                return registry.histogram("lat_ms", unit="", help="x")
+        """)
+        assert _rules(vs) == ["DLT007"]
+
+    def test_full_registration_clean(self):
+        assert _lint("""
+            def setup(registry):
+                registry.counter("requests_total", unit="requests",
+                                 help="requests served")
+                registry.histogram("lat_ms", "ms", "request latency")
+        """) == []
+
+    def test_non_registry_receiver_out_of_scope(self):
+        # CompileWatch.counter(name) is a QUERY, not a registration
+        assert _lint("""
+            def read(watch):
+                return watch.counter("attention.flash")
+        """) == []
+
+    def test_fires_on_bare_counter_dict(self):
+        vs = _lint("""
+            class Stats:
+                def __init__(self):
+                    self.counters = {}
+        """)
+        assert _rules(vs) == ["DLT007"]
+        assert "bare counter dict" in vs[0].message
+
+    def test_fires_on_annotated_counter_dict(self):
+        vs = _lint("""
+            from typing import Dict
+            class W:
+                def __init__(self):
+                    self._event_counters: Dict[str, int] = {}
+        """)
+        assert _rules(vs) == ["DLT007"]
+
+    def test_unrelated_dict_clean(self):
+        assert _lint("""
+            class C:
+                def __init__(self):
+                    self.cache = {}
+                    self.bucket_sizes = {}
+        """) == []
+
+    def test_inline_waiver(self):
+        assert _lint("""
+            class Stats:
+                def __init__(self):
+                    self.counters = {}  # lint: disable=DLT007 (absorbed via obs.absorb_training_stats)
+        """) == []
+
+
 class TestFileWaiver:
     def test_disable_file(self):
         vs = _lint("""

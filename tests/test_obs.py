@@ -1,0 +1,550 @@
+"""Unified observability layer tests: registry, tracer, exporters, crash
+flight recorder — plus the end-to-end chaos post-mortem the ISSUE's
+acceptance names: an elastic worker SIGKILLed mid-epoch leaves a
+flight-recorder dump in storage whose tail spans land in the supervisor's
+``CrashRecord``, while the same run's Prometheus scrape + JSONL event log
+carry the per-step phase breakdown and the membership-transition pause.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import obs
+from deeplearning4j_tpu.checkpoint import CheckpointManager
+from deeplearning4j_tpu.checkpoint.faults import FaultInjector, SimulatedCrash
+from deeplearning4j_tpu.checkpoint.storage import (LocalFSBackend,
+                                                   ObjectStoreBackend)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.obs.flight import latest_dump, read_dumps
+from deeplearning4j_tpu.optimize.updaters import Sgd
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _quiet_telemetry():
+    """Every test starts with tracing off and no flight recorder, and
+    leaves the process the same way (the registry is process-global by
+    design; tests assert deltas/presence, not exclusivity)."""
+    obs.configure_tracer(enabled=False)
+    obs.uninstall_flight_recorder()
+    yield
+    obs.configure_tracer(enabled=False, clock=time.perf_counter)
+    obs.get_tracer().registry = None
+    obs.uninstall_flight_recorder()
+
+
+def small_net(seed=11):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Sgd(learning_rate=0.05))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def toy_batches(n=3, batch=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return [DataSet(rng.standard_normal((batch, 4)).astype(np.float32),
+                    np.eye(3, dtype=np.float32)[rng.integers(0, 3, batch)])
+            for _ in range(n)]
+
+
+# ================================================================ registry
+class TestRegistry:
+    def test_counter_gauge_histogram(self):
+        r = obs.MetricsRegistry()
+        c = r.counter("reqs_total", unit="requests", help="served")
+        c.inc()
+        c.inc(4)
+        assert c.value == 5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        g = r.gauge("depth", unit="requests", help="queue depth")
+        g.set(7)
+        assert g.value == 7
+        h = r.histogram("lat_ms", unit="ms", help="latency")
+        for v in (1, 2, 3, 4, 100):
+            h.observe(v)
+        d = h.as_dict()
+        assert d["count"] == 5 and d["max"] == 100 and d["min"] == 1
+        assert 0 < d["p50"] <= d["p95"] <= d["p99"] <= 100
+
+    def test_registration_is_idempotent_and_kind_checked(self):
+        r = obs.MetricsRegistry()
+        a = r.counter("x_total", unit="x", help="x")
+        assert r.counter("x_total", unit="y", help="z") is a
+        with pytest.raises(obs.MetricError):
+            r.gauge("x_total", unit="x", help="x")
+
+    def test_units_and_help_required(self):
+        r = obs.MetricsRegistry()
+        with pytest.raises(obs.MetricError):
+            r.counter("a_total", unit="", help="h")
+        with pytest.raises(obs.MetricError):
+            r.counter("a_total", unit="u", help=" ")
+        with pytest.raises(obs.MetricError):
+            r.counter("Bad-Name", unit="u", help="h")
+
+    def test_quantiles_bounded_by_observations(self):
+        r = obs.MetricsRegistry()
+        h = r.histogram("q_ms", unit="ms", help="h")
+        for v in (10, 10, 10):
+            h.observe(v)
+        assert h.quantile(0.99) <= 10.0
+        assert h.quantile(0.0) >= 0.0
+
+    def test_collect_callback_absorbs_live_source(self):
+        r = obs.MetricsRegistry()
+        obs.absorb_compile_watch(r)  # direct absorb of the GLOBAL watch
+        assert r.metric("jit_compiles") is not None
+        calls = []
+        r.register_callback(lambda reg: calls.append(1))
+        r.as_dict()
+        assert calls == [1]
+
+    def test_absorb_training_stats(self):
+        from deeplearning4j_tpu.parallel.stats import TrainingStats
+        ts = TrainingStats()
+        ts.record("epoch_sync", 0.25)
+        ts.inc_counter("model_compiles", 3)
+        ts.examples = 64
+        r = obs.MetricsRegistry()
+        obs.absorb_training_stats(r, ts)
+        assert r.metric("train_phase_epoch_sync_total_ms").value == 250.0
+        assert r.metric("train_phase_model_compiles").value == 3
+        assert r.metric("train_phase_examples").value == 64
+
+    def test_watch_training_stats_is_live_and_self_removing(self):
+        from deeplearning4j_tpu.parallel.stats import TrainingStats
+        ts = TrainingStats()
+        r = obs.MetricsRegistry()
+        obs.watch_training_stats(r, ts)
+        ts.examples = 7
+        assert r.as_dict()["train_phase_examples"]["value"] == 7
+        ts.examples = 9  # live source: next scrape sees the new value
+        assert r.as_dict()["train_phase_examples"]["value"] == 9
+        del ts
+        r.as_dict()  # dead weakref: the callback unregisters itself
+        assert not r._callbacks
+
+    def test_parallel_wrapper_wires_stats_into_default_registry(self):
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+        pw = ParallelWrapper(small_net(), collect_stats=True)
+        pw.stats.examples = 31
+        d = obs.get_registry().as_dict()
+        assert d["train_phase_examples"]["value"] == 31
+
+
+# ================================================================== tracer
+class TestTracer:
+    def test_disabled_is_noop_by_opcount(self):
+        """Overhead guard asserted by OP COUNT, not wall clock (the 9p
+        bench-sensitivity note): a disabled tracer never reads the clock,
+        never allocates a span, never touches a sink."""
+        clock_calls = []
+
+        def counting_clock():
+            clock_calls.append(1)
+            return 0.0
+        sink_calls = []
+        t = obs.Tracer(enabled=False, clock=counting_clock)
+        t.add_sink(sink_calls.append)
+        s1 = t.span("a", step=1)
+        s2 = t.span("b")
+        with s1:
+            pass
+        t.event("c", x=1)
+        assert s1 is s2  # the shared no-op singleton: zero allocation
+        assert clock_calls == []
+        assert sink_calls == []
+        data = [1, 2, 3]
+        assert t.wrap_iter(data, "w") is data  # passthrough, not a wrapper
+
+    def test_enabled_records_spans_and_histograms(self):
+        r = obs.MetricsRegistry()
+        sink = []
+        t = obs.Tracer(enabled=True, registry=r)
+        t.add_sink(sink.append)
+        with t.span("phase.one", step=3):
+            pass
+        t.event("boundary", gen=2)
+        kinds = [(s["kind"], s["name"]) for s in sink]
+        assert kinds == [("span", "phase.one"), ("event", "boundary")]
+        assert sink[0]["attrs"] == {"step": 3}
+        assert r.metric("phase_one_ms").count == 1
+
+    def test_wrap_iter_times_each_next(self):
+        sink = []
+        t = obs.Tracer(enabled=True)
+        t.add_sink(sink.append)
+        out = list(t.wrap_iter(iter([10, 20]), "data_wait"))
+        assert out == [10, 20]
+        assert [s["name"] for s in sink] == ["data_wait", "data_wait"]
+
+    def test_sink_errors_never_break_the_span(self):
+        t = obs.Tracer(enabled=True)
+        t.add_sink(lambda rec: (_ for _ in ()).throw(RuntimeError("boom")))
+        with t.span("ok"):
+            pass  # must not raise
+
+    def test_stopwatch_syncs_then_stops(self):
+        import jax.numpy as jnp
+        sw = obs.Stopwatch().start()
+        out = jnp.arange(8) * 2
+        dt = sw.stop(out)
+        assert dt == sw.seconds >= 0.0
+        with obs.Stopwatch() as sw2:
+            pass
+        assert sw2.seconds >= 0.0
+        with pytest.raises(RuntimeError):
+            obs.Stopwatch().stop()
+
+
+# ========================================================== fit phase spans
+class TestFitPhaseBreakdown:
+    def test_mln_fit_emits_phase_spans(self):
+        sink = []
+        obs.configure_tracer(enabled=True)
+        obs.get_tracer().add_sink(sink.append)
+        try:
+            net = small_net()
+            net.fit(toy_batches(3), num_epochs=2)
+        finally:
+            obs.get_tracer().remove_sink(sink.append)
+        names = [s["name"] for s in sink]
+        assert names.count("train.step_host") == 6
+        assert names.count("train.step_device") == 6
+        assert names.count("train.data_wait") == 6
+        host = [s for s in sink if s["name"] == "train.step_host"]
+        assert all("step" in s["attrs"] for s in host)
+
+    def test_disabled_tracer_changes_nothing(self):
+        # identical parameter trajectory with tracing off and on: the
+        # spans are host-side only and never enter the traced program
+        import jax
+        a, b = small_net(seed=5), small_net(seed=5)
+        data = toy_batches(2)
+        a.fit(data)
+        obs.configure_tracer(enabled=True)
+        try:
+            b.fit(data)
+        finally:
+            obs.configure_tracer(enabled=False)
+        for la, lb in zip(jax.tree_util.tree_leaves(a.params),
+                          jax.tree_util.tree_leaves(b.params)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+# ============================================================ serving + ckpt
+class TestInstrumentedSurfaces:
+    def test_parallel_inference_metrics(self):
+        from deeplearning4j_tpu.parallel import ParallelInference
+        reg = obs.get_registry()
+        pad = reg.metric("serving_pad_waste_rows")
+        before = pad.count if pad is not None else 0
+        net = small_net()
+        pi = ParallelInference(net, batch_limit=8, queue_timeout_ms=2)
+        try:
+            pi.output_batched(np.random.default_rng(0).standard_normal(
+                (3, 4)).astype(np.float32))
+            d = reg.as_dict()
+            assert d["serving_requests"]["value"] >= 1
+            assert d["serving_batches_dispatched"]["value"] >= 1
+            assert "serving_hot_swap_swaps" in d
+            assert reg.metric("serving_pad_waste_rows").count > before
+            assert reg.metric("serving_batch_occupancy").count >= 1
+        finally:
+            pi.shutdown()
+
+    def test_checkpoint_commit_and_restore_metrics(self, tmp_path):
+        reg = obs.get_registry()
+        net = small_net()
+        cm = CheckpointManager(str(tmp_path / "ck"), async_write=False)
+        commit_before = reg.metric("checkpoint_commit_ms")
+        commit_before = commit_before.count if commit_before else 0
+        bytes_before = reg.metric("checkpoint_bytes_written_total")
+        bytes_before = bytes_before.value if bytes_before else 0
+        cm.save(net)
+        assert cm.restore_latest() is not None
+        assert reg.metric("checkpoint_commit_ms").count == commit_before + 1
+        assert reg.metric("checkpoint_bytes_written_total").value \
+            > bytes_before
+        assert reg.metric("checkpoint_restore_ms").count >= 1
+        d = reg.as_dict()  # absorb callback pulls the manager's counters
+        assert d["checkpoint_saves_committed"]["value"] >= 1
+
+
+# ================================================================ exporters
+class TestExporters:
+    def test_prometheus_text_format(self):
+        r = obs.MetricsRegistry()
+        r.counter("a_total", unit="x", help="ca").inc(2)
+        r.gauge("b", unit="y", help="gb").set(1.5)
+        h = r.histogram("c_ms", unit="ms", help="hc", buckets=(1, 10))
+        h.observe(0.5)
+        h.observe(5)
+        h.observe(50)
+        txt = obs.prometheus_text(r)
+        assert "# HELP a_total ca [unit: x]" in txt
+        assert "# TYPE a_total counter" in txt and "\na_total 2\n" in txt
+        assert "# TYPE b gauge" in txt
+        assert 'c_ms_bucket{le="1"} 1' in txt
+        assert 'c_ms_bucket{le="10"} 2' in txt
+        assert 'c_ms_bucket{le="+Inf"} 3' in txt
+        assert "c_ms_count 3" in txt
+        # every sample line parses as `name{labels}? value`
+        import re
+        for line in txt.strip().splitlines():
+            if line.startswith("#"):
+                continue
+            assert re.match(
+                r'^[a-z_][a-z0-9_]*(\{le="[^"]+"\})? -?[0-9.e+natif]+$',
+                line), line
+
+    def test_prometheus_endpoint_scrape_parses(self):
+        from deeplearning4j_tpu.storage import InMemoryStatsStorage
+        from deeplearning4j_tpu.ui import UIServer
+        srv = UIServer(port=0).attach(InMemoryStatsStorage())
+        try:
+            base = srv.address.rstrip("/")
+            txt = urllib.request.urlopen(base + "/metrics",
+                                         timeout=10).read().decode()
+            assert "# TYPE jit_compiles gauge" in txt
+            obs_json = json.loads(urllib.request.urlopen(
+                base + "/api/obs", timeout=10).read())
+            assert "jit_compiles" in obs_json
+        finally:
+            srv.stop()
+
+    def test_event_log_roundtrip(self):
+        store = ObjectStoreBackend()
+        elog = obs.EventLog(store, name="ev.jsonl", flush_every=2)
+        elog.emit({"kind": "span", "name": "a", "dur_ms": 1.0, "wall": 1.0})
+        elog.emit({"kind": "event", "name": "b", "wall": 2.0})
+        elog.flush()  # threshold flushes are async; sync before reading
+        recs = obs.read_event_log(store, "ev.jsonl")
+        assert [r["name"] for r in recs] == ["a", "b"]
+
+    def test_tracer_to_event_log_pipeline(self):
+        store = ObjectStoreBackend()
+        elog = obs.EventLog(store, name="t.jsonl", flush_every=1)
+        t = obs.Tracer(enabled=True)
+        t.add_sink(elog)
+        with t.span("x"):
+            pass
+        elog.flush()
+        assert obs.read_event_log(store, "t.jsonl")[0]["name"] == "x"
+
+    def test_dashboard_carries_obs_tiles(self):
+        from deeplearning4j_tpu.ui import dashboard_html
+        html = dashboard_html()
+        assert "/api/obs" in html
+        assert "elastic generation" in html
+        assert "hot swaps" in html and "swap poll errors" in html
+
+    def test_stats_listener_routes_to_registry(self):
+        from deeplearning4j_tpu.storage import InMemoryStatsStorage
+        from deeplearning4j_tpu.ui import StatsListener
+        reg = obs.get_registry()
+        net = small_net()
+        net.set_listeners(StatsListener(InMemoryStatsStorage(),
+                                        session_id="s", worker_id="w"))
+        net.fit(toy_batches(1))
+        assert reg.metric("train_score") is not None
+        assert reg.metric("train_iteration") is not None
+
+
+# ========================================================== flight recorder
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_tail_summarized(self):
+        fr = obs.FlightRecorder(capacity=3, worker_id="w1")
+        for i in range(10):
+            fr.event("e", i=i)
+        tail = fr.tail()
+        assert len(tail) == 3 and tail[-1]["attrs"] == {"i": 9}
+        assert all("event e" in s for s in fr.tail_summary())
+
+    def test_flush_on_fault_injector_kill(self):
+        store = ObjectStoreBackend()
+        obs.configure_tracer(enabled=True)
+        obs.install_flight_recorder(store=store, worker_id="w2")
+        net = small_net()
+        net.set_listeners(FaultInjector(kill_at_step=2))
+        with pytest.raises(SimulatedCrash):
+            net.fit(toy_batches(4), num_epochs=3)
+        dump = latest_dump(store)
+        assert dump is not None and dump["worker_id"] == "w2"
+        assert dump["reason"].startswith("fault injection")
+        names = {e["name"] for e in dump["events"]}
+        assert "train.step_host" in names  # the victim's last seconds
+
+    def test_flush_on_watchdog_timeout(self):
+        from deeplearning4j_tpu.parallel.watchdog import (
+            CollectiveTimeoutError, CollectiveWatchdog)
+        store = ObjectStoreBackend()
+        obs.install_flight_recorder(store=store, worker_id="w3")
+        with pytest.raises(CollectiveTimeoutError):
+            CollectiveWatchdog(timeout_s=0.05).call(
+                lambda: time.sleep(0.5), what="hung allgather")
+        dump = latest_dump(store)
+        assert dump is not None
+        assert dump["reason"].startswith("watchdog timeout")
+        assert any(e["name"] == "watchdog.timeout" for e in dump["events"])
+
+    def test_train_until_attaches_in_process_tail(self, tmp_path):
+        from deeplearning4j_tpu.checkpoint.resume import train_until
+        obs.configure_tracer(enabled=True)
+        obs.install_flight_recorder(worker_id="w4")  # no store: ring only
+        net = small_net()
+        net.set_listeners(FaultInjector(kill_at_step=2))
+        cm = CheckpointManager(str(tmp_path / "ck"), save_every_n_steps=1,
+                               async_write=False)
+        summary = train_until(net, toy_batches(3), num_epochs=2,
+                              checkpoint_manager=cm)
+        assert summary.completed and summary.crashes
+        tail = summary.crashes[0].flight_tail
+        assert tail and any("train.step" in line for line in tail)
+
+
+# ===================================================== obs_report CLI smoke
+class TestObsReport:
+    def _make_records(self):
+        store = ObjectStoreBackend()
+        elog = obs.EventLog(store, name="r.jsonl", flush_every=1)
+        t = obs.Tracer(enabled=True)
+        t.add_sink(elog)
+        for i in range(4):
+            with t.span("train.step_host", step=i):
+                pass
+            with t.span("train.step_device", step=i):
+                pass
+        t.event("elastic.generation_start", generation=1, world=2)
+        elog.flush()
+        return obs.read_event_log(store, "r.jsonl")
+
+    def test_render_report_sections(self):
+        sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+        try:
+            import obs_report
+        finally:
+            sys.path.pop(0)
+        records = self._make_records()
+        dump = {"worker_id": "w9", "reason": "fault injection: kill",
+                "time": 1.0, "events": records[-3:]}
+        text = obs_report.render_report(records, [dump], top=5)
+        assert "Per-step phase breakdown" in text
+        assert "train.step_host" in text and "train.step_device" in text
+        assert "Slowest spans" in text
+        assert "Crash-ring tail — worker w9" in text
+        assert "fault injection: kill" in text
+        assert "elastic.generation_start" in text
+
+    def test_cli_on_files(self, tmp_path):
+        records = self._make_records()
+        p = tmp_path / "run.jsonl"
+        p.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+        dump_p = tmp_path / "flightrec-w9"
+        dump_p.write_text(json.dumps(
+            {"worker_id": "w9", "reason": "watchdog timeout: x",
+             "time": 2.0, "events": records[:2]}))
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "obs_report.py"),
+             str(p), str(dump_p), "--top", "3"],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert "observability report" in out.stdout
+        assert "Crash-ring tail" in out.stdout
+
+
+# ================================================= chaos post-mortem (E2E)
+class TestChaosPostMortem:
+    """ISSUE acceptance: SIGKILLed elastic worker → flight dump in storage
+    whose tail spans reach the supervisor's CrashRecord; the run's
+    Prometheus scrape + JSONL event log carry the per-step phase breakdown
+    and the membership-transition pause."""
+
+    def test_sigkill_postmortem_end_to_end(self, tmp_path):
+        from deeplearning4j_tpu.checkpoint.supervisor import (
+            train_until_process)
+        store_dir = str(tmp_path / "store")
+        os.makedirs(store_dir, exist_ok=True)
+        worker_py = os.path.join(REPO_ROOT, "tests", "obs_worker.py")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO_ROOT)
+
+        def argv_for(index, attempt):
+            return [sys.executable, worker_py, store_dir, "w00",
+                    str(attempt), "2", "2"]
+
+        cm_reader = CheckpointManager(storage=LocalFSBackend(store_dir))
+        summary = train_until_process(
+            argv_for, num_workers=1, respawn_preempted=True,
+            checkpoint_manager=cm_reader,
+            attempt_timeout_s=240.0, overall_timeout_s=480.0,
+            poll_s=0.1, env=env,
+            log_dir=str(tmp_path / "logs"))
+        assert summary.completed, summary
+
+        # --- the SIGKILL left a crash record with the victim's last
+        #     seconds, read back across the process boundary
+        pre = [c for c in summary.crashes if c.error_type == "Preempted"]
+        assert pre, summary.crashes
+        tail = pre[0].flight_tail
+        assert tail, "supervisor attached no flight tail"
+        assert any("fault injection" in line for line in tail)
+        assert any("train.step" in line for line in tail)
+
+        # --- the flight dump itself is durable in the store
+        backend = LocalFSBackend(store_dir)
+        dumps = read_dumps(backend)
+        assert dumps and dumps[-1]["worker_id"] == "w00"
+        dump_names = {e["name"] for e in dumps[-1]["events"]}
+        assert "train.step_host" in dump_names
+        assert "elastic.generation_start" in dump_names
+
+        # --- the JSONL event log carries the phase breakdown AND the
+        #     membership-transition pause of the respawned generation
+        records = []
+        for name in backend.list(prefix="events-"):
+            records.extend(obs.read_event_log(backend, name))
+        names = {r["name"] for r in records}
+        assert {"train.data_wait", "train.step_host",
+                "train.step_device"} <= names
+        pauses = [r for r in records
+                  if r["name"] == "elastic.transition_pause"]
+        assert pauses and pauses[0]["attrs"]["generation"] == 2
+        assert pauses[0]["attrs"]["pause_ms"] > 0
+
+        # --- the same run's Prometheus scrape (through the real /metrics
+        #     endpoint inside the worker) has both as metrics
+        scrapes = backend.list(prefix="prom-")
+        assert scrapes, "worker saved no /metrics scrape"
+        txt = backend.get(scrapes[-1]).decode()
+        assert "train_step_host_ms_bucket" in txt
+        assert "train_step_device_ms_count" in txt
+        assert "elastic_transition_pause_ms_count 1" in txt
+        assert "\nelastic_generation 2" in txt
+
+        # --- and the report CLI renders the whole post-mortem
+        sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+        try:
+            import obs_report
+        finally:
+            sys.path.pop(0)
+        text = obs_report.render_report(records, dumps)
+        assert "Per-step phase breakdown" in text
+        assert "Crash-ring tail — worker w00" in text

@@ -254,7 +254,7 @@ def test_inline_js_structural_contract():
     pages = {"dashboard": dashboard_html(),
              "tsne": ui_server._TSNE_HTML,
              "activations": ui_server._ACTIVATIONS_HTML}
-    served = ["/api/sessions", "/api/static", "/api/updates",
+    served = ["/api/sessions", "/api/static", "/api/updates", "/api/obs",
               "/api/tsne/sessions", "/api/tsne/data", "/api/tsne/upload",
               "/api/activations/sessions", "/api/activations/data",
               "/remoteReceive"]

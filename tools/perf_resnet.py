@@ -6,7 +6,6 @@ from the last step's output (block_until_ready does not force completion
 through the axon tunnel). Run: PYTHONPATH=. python tools/perf_resnet.py
 """
 import dataclasses as dc
-import time
 
 import jax
 import jax.numpy as jnp
@@ -25,14 +24,15 @@ def _fwd_flops(net):
 
 
 def bench(run_one, fetch, steps=20, warmup=3):
+    from deeplearning4j_tpu.obs import Stopwatch
     for _ in range(warmup):
         run_one()
     fetch()
-    t0 = time.perf_counter()  # lint: disable=DLT003 (fetch() is the sync: reads the last step's output)
+    sw = Stopwatch().start()
     for _ in range(steps):
         run_one()
-    fetch()
-    return (time.perf_counter() - t0) / steps
+    fetch()  # the sync: reads a VALUE derived from the last step's output
+    return sw.stop() / steps
 
 
 def main():

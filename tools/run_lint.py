@@ -6,10 +6,11 @@ Usage:
 
 With no arguments lints the tier-1 surface: ``deeplearning4j_tpu/``,
 ``bench.py`` and ``tools/``. Exits 1 on any violation — the same contract
-``tests/test_lint.py`` enforces in CI. Rules DLT001-DLT006 (import-time
+``tests/test_lint.py`` enforces in CI. Rules DLT001-DLT007 (import-time
 jnp, impure-in-jit, unsynced bench stopwatches, lock-order, unfolded
-serving BN, swallowed checkpoint/storage errors) are documented in
-``analysis/lint.py``. Waive a finding inline with
+serving BN, swallowed checkpoint/storage errors, metrics registered
+without units/help) are documented in ``analysis/lint.py``. Waive a
+finding inline with
 ``# lint: disable=DLT00X`` (or file-wide with ``# lint: disable-file=...``)
 and a short justification.
 """
