@@ -8,6 +8,9 @@ Covers BASELINE.json configs[0]-[3] plus the serving microbench:
   4. ParallelInference serving (concurrent clients, mixed request sizes)
                                                  -> req/sec + p50/p99 latency,
                                                     batch-size summary, compiles
+  4b. serving_load: open-loop Poisson HTTP load against serving.ModelServer
+                                                 -> goodput, p50/p99, shed +
+                                                    expired rates, occupancy
   5. Checkpoint overhead (checkpoint/ subsystem) -> steps/sec off vs async
                                                     vs sync save_every_n_steps
 
@@ -476,6 +479,125 @@ def bench_serving():
               "must hold (shape-stability tripwire). " % sizes + _REPS_NOTE)
 
 
+def bench_serving_load():
+    """Open-loop serving load bench against the serving/ HTTP front-end:
+    seeded POISSON arrivals at a configured offered load. Unlike the
+    closed-loop bench_serving clients (whose arrival rate collapses to
+    the service rate the moment the server slows), an open-loop generator
+    keeps offering load under overload — which is exactly what exposes
+    the admission-control story: goodput, p50/p99 latency, shed rate
+    (429s), deadline expiries (504s) and batch occupancy at the offered
+    rate. Metrics only on container runs per the 9p/bench-sensitivity
+    note; thresholds belong to quiet full runs."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    from deeplearning4j_tpu.serving import ModelServer
+
+    if QUICK:
+        offered_rps, duration_s, deadline_ms, hidden = 60.0, 1.2, 1000.0, 32
+    else:
+        offered_rps, duration_s, deadline_ms, hidden = 400.0, 5.0, 250.0, 256
+    n_features, n_classes = 784, 10
+    conf = (NeuralNetConfiguration.builder()
+            .seed(11).updater(Sgd(learning_rate=0.01)).weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_out=n_classes, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(n_features))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    sizes = [1, 2, 4, 8]
+    srv = ModelServer(default_deadline_ms=deadline_ms)
+    ep = srv.add_model("mlp", net, queue_depth=64,
+                       warmup_example=np.zeros((1, n_features), np.float32))
+    # worst coalesced dispatch = batch_limit requests of the largest size;
+    # warm the whole ladder so no live request pays an XLA compile
+    ep.warmup_buckets = ep.pi.bucket_policy.buckets_up_to(
+        ep.pi.batch_limit * max(sizes))
+    srv.start(warmup_async=False)  # /readyz gating: ladder compiled first
+    url = srv.address + "/v1/models/mlp:predict"
+    payloads = [json.dumps(
+        {"inputs": np.zeros((s, n_features), np.float32).tolist()}).encode()
+        for s in sizes]
+    results: list = []
+    res_lock = threading.Lock()
+
+    def fire(body):
+        sw = Stopwatch().start()
+        try:
+            with urllib.request.urlopen(urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/json"}),
+                    timeout=30) as r:
+                r.read()
+                code = r.status
+        except urllib.error.HTTPError as e:
+            e.read()
+            code = e.code
+        except Exception:
+            code = -1
+        sw.stop()  # the HTTP response IS host-synced data
+        with res_lock:
+            results.append((code, sw.seconds))
+
+    # the arrival schedule is drawn up front (seeded), then replayed on
+    # the wall clock: arrivals never wait for completions (open loop)
+    rng = np.random.default_rng(1234)
+    arrivals, t = [], 0.0
+    while True:
+        t += float(rng.exponential(1.0 / offered_rps))
+        if t >= duration_s:
+            break
+        arrivals.append(t)
+    threads = []
+    sw_run = Stopwatch().start()
+    start = time.perf_counter()
+    for i, at in enumerate(arrivals):
+        delay = start + at - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        th = threading.Thread(target=fire, args=(payloads[i % len(sizes)],),
+                              daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=60)
+    wall = float(sw_run.stop())  # client threads joined: host-synced
+
+    codes = [c for c, _ in results]
+    ok_lat = [l * 1000.0 for c, l in results if c == 200]
+    shed = codes.count(429)
+    expired = codes.count(504)
+    other = sum(1 for c in codes if c not in (200, 429, 504))
+    st = srv.endpoints["mlp"].stats()
+    srv.stop(drain=True)
+    n = max(1, len(results))
+    emit("serving_load_goodput_reqs_per_sec", len(ok_lat) / wall,
+         "req/sec", "serving",
+         offered_rps=offered_rps,
+         arrivals=len(arrivals),
+         ok=len(ok_lat), shed=shed, expired=expired, other=other,
+         shed_rate=round(shed / n, 3),
+         expired_rate=round(expired / n, 3),
+         p50_ms=(round(float(np.percentile(ok_lat, 50)), 2)
+                 if ok_lat else None),
+         p99_ms=(round(float(np.percentile(ok_lat, 99)), 2)
+                 if ok_lat else None),
+         batch_occupancy=st["batch_size"],
+         queue=st["queue"],
+         note="open-loop seeded Poisson arrivals over HTTP at the offered "
+              "rate (request sizes cycling %s, deadline %gms); shed = 429 "
+              "admission rejections, expired = 504 deadline evictions. "
+              "metrics only — thresholds on quiet full runs per the 9p "
+              "note. " % (sizes, deadline_ms) + _REPS_NOTE)
+
+
 def bench_checkpoint():
     """Checkpoint-overhead microbench: steps/sec for the same small-MLP
     train loop with checkpointing OFF, ASYNC every N steps (checkpoint/
@@ -799,6 +921,7 @@ def bench_elastic():
 def main():
     benches = [("lenet", bench_lenet), ("word2vec", bench_word2vec),
                ("charlstm", bench_graveslstm), ("serving", bench_serving),
+               ("serving_load", bench_serving_load),
                ("checkpoint", bench_checkpoint),
                ("resilience", bench_resilience),
                ("elastic", bench_elastic),

@@ -54,6 +54,14 @@ Rules (waivable per line with ``# lint: disable=DLT00X`` or per file with
   (``CompileWatch``, ``TrainingStats``) are absorbed into the registry by
   ``obs.absorb_*`` and carry inline waivers.
 
+- **DLT008 unbounded-queue**: in serving/parallel/datasets/storage/
+  checkpoint paths, a ``queue.Queue()`` with no ``maxsize`` (or an
+  explicit ``maxsize=0``) is an unbounded buffer between threads — a
+  stalled consumer then grows host memory without limit and every
+  producer waits forever instead of failing fast. Pass a bound (with
+  explicit full-queue semantics, e.g. ``ParallelInference``'s
+  block-with-timeout ⇒ ``QueueFullError``), or waive inline like DLT003.
+
 Adding a rule: write a ``_rule_xxx(tree, src, path) -> List[LintViolation]``
 function and register it in ``_RULES``; tests in ``tests/test_lint.py``
 seed a fixture violating the rule and assert it fires.
@@ -535,6 +543,44 @@ def _rule_metric_registration(tree, src, path) -> List[LintViolation]:
     return out
 
 
+# ------------------------------------------------------------------ DLT008
+def _is_bounded_buffer_path(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return any(seg in p for seg in ("serving/", "parallel/", "datasets/",
+                                    "storage/", "checkpoint/"))
+
+
+def _rule_unbounded_queue(tree, src, path) -> List[LintViolation]:
+    if not _is_bounded_buffer_path(path):
+        return []
+    aliases = _import_aliases(tree)
+    out: List[LintViolation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _resolve(_dotted(node.func), aliases) != "queue.Queue":
+            continue
+        # maxsize is the single positional; a literal 0 (stdlib's
+        # "infinite") is exactly as unbounded as omitting it
+        bound = None
+        if node.args:
+            bound = node.args[0]
+        for kw in node.keywords:
+            if kw.arg == "maxsize":
+                bound = kw.value
+        unbounded = bound is None or (isinstance(bound, ast.Constant)
+                                      and bound.value == 0)
+        if unbounded:
+            out.append(LintViolation(
+                path, node.lineno, "DLT008",
+                "unbounded queue.Queue() in a serving/parallel/data/"
+                "storage path — a stalled consumer grows host memory "
+                "without limit and producers wait forever; pass maxsize= "
+                "with explicit full-queue semantics (shed/timeout), or "
+                "waive inline"))
+    return out
+
+
 # ----------------------------------------------------------------- harness
 _RULES = (
     _rule_module_level_jnp,
@@ -544,6 +590,7 @@ _RULES = (
     _rule_serving_bn_fold,
     _rule_swallowed_storage_error,
     _rule_metric_registration,
+    _rule_unbounded_queue,
 )
 
 

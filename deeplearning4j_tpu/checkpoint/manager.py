@@ -621,10 +621,15 @@ class CheckpointManager:
         from deeplearning4j_tpu.checkpoint.storage import StorageError
         try:
             entries = self._mf.load_manifest(self._storage)
+            self.last_refresh_error = None
         except (self._mf.ManifestError, StorageError, OSError) as e:
             log.warning("manifest refresh failed (%s: %s) — keeping the "
                         "previously loaded journal", type(e).__name__, e)
             entries = None
+            # stashed, not raised: this reader stays serviceable on the
+            # known journal, but pollers (serving hot-swap) need to SEE
+            # the store erroring so they can back their cadence off
+            self.last_refresh_error = e
         with self._lock:
             if entries is not None:
                 self._entries = entries

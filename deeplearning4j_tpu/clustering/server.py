@@ -18,6 +18,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from deeplearning4j_tpu.clustering.vptree import VPTree
+from deeplearning4j_tpu.utils.http import parse_content_length
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -34,6 +35,20 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _read_body(self) -> bytes:
+        """Size-capped body read (utils/http.py contract: a
+        missing/invalid Content-Length is a structured 400, an oversized
+        one a structured 413, both answered BEFORE reading the payload).
+        Returns None after answering the error."""
+        srv = type(self).server_ref
+        length, err = parse_content_length(self.headers,
+                                           srv.max_body_bytes)
+        if err is not None:
+            code, message = err
+            self._json({"error": message}, code)
+            return None
+        return self.rfile.read(length)
+
     def do_GET(self):
         srv = type(self).server_ref
         if self.path in ("/status", "/"):
@@ -44,9 +59,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         srv = type(self).server_ref
+        raw = self._read_body()
+        if raw is None:
+            return
         try:
-            length = int(self.headers.get("Content-Length", 0))
-            req = json.loads(self.rfile.read(length))
+            req = json.loads(raw)
+            if not isinstance(req, dict):
+                self._json({"error": "request body must be a JSON object"},
+                           400)
+                return
             k = int(req.get("k", 1))
             if k < 1:
                 self._json({"error": f"k must be >= 1; got {k}"}, 400)
@@ -85,12 +106,15 @@ class NearestNeighborsServer:
     /knnnew (see module docstring)."""
 
     def __init__(self, points, labels: Optional[Sequence[str]] = None,
-                 distance: str = "euclidean"):
+                 distance: str = "euclidean", max_body_bytes: int = 1 << 20):
         self.points = np.asarray(points, np.float64)
         if labels is not None and len(labels) != len(self.points):
             raise ValueError("labels length must match points")
         self.labels = list(labels) if labels is not None else None
         self.tree = VPTree(self.points, distance=distance)
+        # a k-NN query is one vector: anything beyond ~1MB is abuse, and
+        # an uncapped read lets one POST grow server memory arbitrarily
+        self.max_body_bytes = int(max_body_bytes)
         self._httpd: Optional[ThreadingHTTPServer] = None
 
     def start(self, port: int = 9200,

@@ -29,8 +29,8 @@ Turn it all on in three lines::
 from deeplearning4j_tpu.obs.registry import (  # noqa: F401
     Counter, Gauge, Histogram, MetricError, MetricsRegistry,
     absorb_checkpoint_manager, absorb_compile_watch, absorb_inference_stats,
-    absorb_training_stats, get_registry, publish_stats_update,
-    watch_training_stats)
+    absorb_model_server, absorb_training_stats, get_registry,
+    publish_stats_update, watch_training_stats)
 from deeplearning4j_tpu.obs.trace import (  # noqa: F401
     Stopwatch, Tracer, configure_tracer, get_tracer)
 from deeplearning4j_tpu.obs.flight import (  # noqa: F401

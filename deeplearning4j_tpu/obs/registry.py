@@ -40,6 +40,7 @@ __all__ = [
     "get_registry", "absorb_compile_watch", "absorb_training_stats",
     "watch_training_stats",
     "absorb_inference_stats", "absorb_checkpoint_manager",
+    "absorb_model_server",
     "publish_stats_update", "DEFAULT_BUCKETS_MS",
 ]
 
@@ -413,6 +414,16 @@ def absorb_inference_stats(registry: MetricsRegistry, pi):
         reg.gauge("serving_unwarmed_dispatches", unit="dispatches",
                   help="dispatches at a bucket size never warmed up"
                   ).set(st["unwarmed_dispatches"])
+        q = st["queue"]
+        reg.gauge("serving_queue_bound", unit="requests",
+                  help="configured bound of the admission queue "
+                       "(queue_depth)").set(q["depth"])
+        reg.gauge("serving_queue_rejected", unit="requests",
+                  help="submits rejected with QueueFullError by the "
+                       "bounded admission queue").set(q["rejected"])
+        reg.gauge("serving_deadline_evictions", unit="requests",
+                  help="requests evicted at batch formation because their "
+                       "deadline expired before dispatch").set(q["expired"])
         hs = st["hot_swap"]
         reg.gauge("serving_hot_swap_swaps", unit="swaps",
                   help="checkpoint hot-swaps applied to the serving model"
@@ -433,6 +444,44 @@ def absorb_inference_stats(registry: MetricsRegistry, pi):
             for key, val in st.get(section, {}).items():
                 reg.gauge(f"serving_{_sanitize(key)}", unit="events",
                           help=f"model kernel-path counter '{key}'").set(val)
+
+    registry.register_callback(_cb)
+    return _cb
+
+
+def absorb_model_server(registry: MetricsRegistry, server):
+    """Register a collect-time callback pulling a ``serving.ModelServer``'s
+    drain state and per-endpoint breaker aggregates into gauges. Weakref'd
+    + self-removing like the other absorbers (the server's own counters —
+    shed/expired/request_ms — are live registry instruments already; this
+    bridge covers the derived/aggregate state)."""
+    ref = weakref.ref(server)
+
+    def _cb(reg: MetricsRegistry):
+        live = ref()
+        if live is None:
+            reg.unregister_callback(_cb)
+            return
+        reg.gauge("serving_models", unit="models",
+                  help="models registered on the serving front-end"
+                  ).set(len(live.endpoints))
+        reg.gauge("serving_draining", unit="bool",
+                  help="1 while the server drains (new arrivals shed, "
+                       "in-flight completing)").set(1.0 if live.draining
+                                                   else 0.0)
+        reg.gauge("serving_ready", unit="bool",
+                  help="1 when every endpoint is warmed and the server "
+                       "is not draining (/readyz)"
+                  ).set(1.0 if live.readiness()[0] else 0.0)
+        breakers = [ep.breaker for ep in live.endpoints.values()]
+        reg.gauge("serving_breakers_open", unit="breakers",
+                  help="endpoints whose circuit breaker is currently not "
+                       "closed (open or half-open)"
+                  ).set(sum(1 for b in breakers
+                            if b.state != "closed"))
+        reg.gauge("serving_breaker_opens", unit="events",
+                  help="cumulative breaker open transitions across all "
+                       "endpoints").set(sum(b.opens for b in breakers))
 
     registry.register_callback(_cb)
     return _cb

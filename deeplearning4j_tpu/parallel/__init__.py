@@ -4,7 +4,11 @@ from deeplearning4j_tpu.parallel.trainer import (  # noqa: F401
     EarlyStoppingParallelTrainer,
     ParallelWrapper,
 )
-from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401
+from deeplearning4j_tpu.parallel.inference import (  # noqa: F401
+    DeadlineExpiredError,
+    ParallelInference,
+    QueueFullError,
+)
 from deeplearning4j_tpu.parallel.sharding import (  # noqa: F401
     ShardIterator,
     UnequalShardError,

@@ -21,6 +21,7 @@ counts and the model's compile counters.
 from __future__ import annotations
 
 import queue
+import random
 import threading
 import time
 from collections import Counter, deque
@@ -32,6 +33,23 @@ import numpy as np
 
 from deeplearning4j_tpu.parallel.mesh import data_sharding, make_mesh, replicated
 from deeplearning4j_tpu.perf.bucketing import BucketPolicy, pad_to_bucket
+
+
+class QueueFullError(RuntimeError):
+    """The bounded request queue stayed full past the admission timeout.
+
+    Raised by :meth:`ParallelInference.submit` instead of blocking forever
+    (the pre-bound queue grew without limit under a stalled worker). A
+    serving front-end maps this to HTTP 429 — shed load, never queue it
+    unboundedly."""
+
+
+class DeadlineExpiredError(TimeoutError):
+    """The request's deadline passed before its batch dispatched.
+
+    Expired requests are evicted at batch formation — they never occupy a
+    device-batch slot they cannot use — and their ``get()`` raises this.
+    A serving front-end maps it to HTTP 504."""
 
 
 class InferenceObservable:
@@ -70,6 +88,14 @@ class ParallelInference:
     thread (reference InferenceMode.BATCHED); "sequential" dispatches each
     request on the caller's thread (InferenceMode.SEQUENTIAL).
 
+    queue_depth / queue_put_timeout_ms: the request queue is BOUNDED —
+    when no slot frees within the timeout, ``submit`` raises
+    :class:`QueueFullError` instead of growing host memory without limit.
+    Per-request deadlines (``submit(x, deadline=...)``) are honored at
+    batch formation: expired requests are evicted before device dispatch
+    (:class:`DeadlineExpiredError`), never wasting a batch slot. The
+    ``serving`` subsystem maps these to HTTP 429/504.
+
     bucket_policy: perf.BucketPolicy controlling the canonical dispatch
     sizes (default: power-of-two buckets with floor 8). Pass ``None`` to
     disable bucketing — every distinct padded batch size then compiles its
@@ -96,9 +122,15 @@ class ParallelInference:
                  bucket_policy=_DEFAULT_POLICY,
                  batch_size_history: int = 1024, fold_bn: bool = False,
                  checkpoint_manager=None,
-                 checkpoint_poll_secs: Optional[float] = None):
+                 checkpoint_poll_secs: Optional[float] = None,
+                 queue_depth: int = 1024,
+                 queue_put_timeout_ms: float = 50.0):
         if inference_mode not in ("batched", "sequential"):
             raise ValueError(f"unknown inference_mode '{inference_mode}'")
+        if int(queue_depth) < 1:
+            raise ValueError(f"queue_depth must be >= 1; got {queue_depth}")
+        if queue_put_timeout_ms < 0:
+            raise ValueError("queue_put_timeout_ms must be >= 0")
         self._fold_bn = bool(fold_bn)
         # read checkpoint provenance BEFORE folding: fold_bn rebuilds the
         # model and does not carry _restored_from over, and losing it here
@@ -120,7 +152,14 @@ class ParallelInference:
             model.init()
         repl = jax.tree_util.tree_map(lambda a: replicated(self.mesh), model.params)
         model.params = jax.device_put(model.params, repl)
-        self._q: "queue.Queue" = queue.Queue()
+        # BOUNDED admission queue: a stalled worker (wedged device call,
+        # slow model) must turn into fast typed rejections upstream, not
+        # unbounded host-memory growth with every request waiting forever
+        self.queue_depth = int(queue_depth)
+        self.queue_put_timeout_ms = float(queue_put_timeout_ms)
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.queue_depth)
+        self.queue_rejections = 0
+        self.deadline_evictions = 0
         self._worker: Optional[threading.Thread] = None
         self._worker_lock = threading.Lock()
         self._stop = threading.Event()
@@ -152,6 +191,12 @@ class ParallelInference:
         self._swap_stop = threading.Event()
         self.swaps = 0
         self.swap_poll_errors = 0
+        # poll backoff under a broken store (utils/backoff.py): seeded per
+        # instance so the schedule is reproducible, jittered so a fleet of
+        # servers polling one dead store doesn't re-synchronize its retries
+        self._swap_backoff_rng = random.Random(0xD14)
+        self.swap_consecutive_errors = 0
+        self.swap_last_poll_delay: Optional[float] = None
         self.current_checkpoint_step = (None if restored_from is None
                                         else int(restored_from.step))
         # obs: hot-path instruments are shared process-wide (the registry
@@ -313,19 +358,42 @@ class ParallelInference:
         if t is not None and t.is_alive():
             t.join(timeout=5)
 
+    def _next_poll_delay(self, poll_secs: float, consecutive_errors: int,
+                         cap_s: float = 30.0) -> float:
+        """Poll cadence given the current error streak: the configured
+        ``poll_secs`` while healthy, plus a capped-exponential-jitter
+        backoff (utils/backoff.py) once the store starts erroring — a dead
+        backend must not be hammered at full poll rate, and recovery resets
+        to the configured cadence."""
+        if consecutive_errors <= 0:
+            return poll_secs
+        from deeplearning4j_tpu.utils.backoff import backoff_delay
+        return poll_secs + backoff_delay(consecutive_errors - 1,
+                                         base_s=max(poll_secs, 0.05),
+                                         cap_s=cap_s,
+                                         rng=self._swap_backoff_rng)
+
     def _hot_swap_loop(self, poll_secs: float):
-        while not self._swap_stop.wait(poll_secs):
+        delay = poll_secs
+        while not self._swap_stop.wait(delay):
             try:
                 self.poll_checkpoint()
+                with self._stats_lock:
+                    self.swap_consecutive_errors = 0
             except Exception:
                 # the serving path must outlive a broken store; the error
                 # count is surfaced in stats() for alerting
                 with self._stats_lock:
                     self.swap_poll_errors += 1
+                    self.swap_consecutive_errors += 1
                 import logging
                 logging.getLogger(__name__).exception(
                     "checkpoint hot-swap poll failed; serving continues "
                     "on the current params")
+            with self._stats_lock:
+                delay = self._next_poll_delay(poll_secs,
+                                              self.swap_consecutive_errors)
+                self.swap_last_poll_delay = delay
 
     def poll_checkpoint(self) -> bool:
         """One hot-swap probe: is there a newer committed checkpoint than
@@ -341,6 +409,13 @@ class ParallelInference:
         if cm is None:
             return False
         cm.refresh()
+        refresh_err = getattr(cm, "last_refresh_error", None)
+        if refresh_err is not None:
+            # the journal re-read failed: this probe learned NOTHING (the
+            # manager deliberately keeps serving its known journal) —
+            # surface the store fault so the poll loop counts it and
+            # backs off instead of hammering a dead store at full cadence
+            raise refresh_err
         step = cm.latest_step()
         if step is None or (self.current_checkpoint_step is not None
                             and step <= self.current_checkpoint_step):
@@ -411,9 +486,19 @@ class ParallelInference:
             swaps = self.swaps
             current_step = self.current_checkpoint_step
             swap_errors = self.swap_poll_errors
+            rejected = self.queue_rejections
+            expired = self.deadline_evictions
+            swap_consec = self.swap_consecutive_errors
+            swap_delay = self.swap_last_poll_delay
         out = {
             "requests_served": requests_served,
             "batches_dispatched": batches_dispatched,
+            "queue": {
+                "depth": self.queue_depth,
+                "size": self._q.qsize(),
+                "rejected": rejected,
+                "expired": expired,
+            },
             "batch_size": self._size_summary(sizes),
             "row_size": self._size_summary(rows),
             "bucket_policy": (None if self.bucket_policy is None
@@ -426,6 +511,9 @@ class ParallelInference:
                 "swaps": swaps,
                 "current_checkpoint_step": current_step,
                 "poll_errors": swap_errors,
+                "consecutive_poll_errors": swap_consec,
+                "last_poll_delay_s": (None if swap_delay is None
+                                      else round(swap_delay, 4)),
             },
         }
         cw = getattr(self.model, "compile_watch", None)
@@ -456,25 +544,59 @@ class ParallelInference:
         return out
 
     # -------------------------------------------------------- batched path
-    def submit(self, x) -> InferenceObservable:
+    def submit(self, x, deadline: Optional[float] = None
+               ) -> InferenceObservable:
         """Enqueue one request; returns its observable (reference
-        ParallelInference.java:97 observable provider)."""
+        ParallelInference.java:97 observable provider).
+
+        ``deadline``: absolute ``time.monotonic()`` timestamp after which
+        the caller no longer wants the answer. Expired requests are
+        evicted at batch formation — BEFORE device dispatch, so they never
+        occupy a batch slot they cannot use — and their ``get()`` raises
+        :class:`DeadlineExpiredError`.
+
+        Full-queue semantics: block up to ``queue_put_timeout_ms`` for a
+        slot, then raise :class:`QueueFullError` — load is shed to the
+        caller, never queued unboundedly."""
         obs = InferenceObservable()
         if self.inference_mode == "sequential":
             try:
+                if deadline is not None and time.monotonic() >= deadline:
+                    with self._stats_lock:
+                        self.deadline_evictions += 1
+                    raise DeadlineExpiredError(
+                        "request deadline expired before dispatch")
                 obs._resolve(self.output(np.asarray(x)))
             except BaseException as e:  # surfaced at .get()
                 obs._fail(e)
             with self._stats_lock:
                 self.requests_served += 1
             return obs
-        # enqueue + worker liveness under one lock: a concurrent shutdown()
-        # (same lock) can then never strand this request between the put and
-        # the worker start
-        with self._worker_lock:
-            self._q.put((np.asarray(x), obs))
-            self._ensure_worker_locked()
-        return obs
+        item = (np.asarray(x), obs, deadline)
+        give_up = time.monotonic() + self.queue_put_timeout_ms / 1000.0
+        while True:
+            # enqueue + worker liveness under ONE lock: a concurrent
+            # shutdown() (same lock) can then never strand this request
+            # between the put and the worker start. The put itself is
+            # non-blocking — a submitter waiting for a slot must never
+            # hold the lock shutdown() needs.
+            with self._worker_lock:
+                try:
+                    self._q.put_nowait(item)
+                except queue.Full:
+                    pass
+                else:
+                    self._ensure_worker_locked()
+                    return obs
+            remaining = give_up - time.monotonic()
+            if remaining <= 0:
+                with self._stats_lock:
+                    self.queue_rejections += 1
+                raise QueueFullError(
+                    f"request queue full (queue_depth={self.queue_depth})"
+                    f" after {self.queue_put_timeout_ms:g}ms — the worker "
+                    "is not draining fast enough; shed load upstream")
+            time.sleep(min(0.001, remaining))
 
     def output_batched(self, x) -> np.ndarray:
         """Blocking convenience over submit() (reference
@@ -491,7 +613,10 @@ class ParallelInference:
             w = self._worker
             if w is not None and w.is_alive():
                 self._stop.set()
-                self._q.put(ParallelInference._SENTINEL)
+                try:  # wake the worker promptly; a FULL queue already
+                    self._q.put_nowait(ParallelInference._SENTINEL)
+                except queue.Full:  # keeps it busy and re-checking _stop
+                    pass
                 w.join(timeout=10)
                 if w.is_alive():
                     # worker is wedged (e.g. inside a device call): fail the
@@ -556,6 +681,27 @@ class ParallelInference:
             items = self._collect()
             if not items:
                 continue
+            # deadline eviction at BATCH FORMATION: an expired request is
+            # answered (DeadlineExpiredError) before device dispatch and
+            # never occupies a batch slot it cannot use — the batch that
+            # does dispatch carries only requests whose callers still want
+            # the answer
+            now = time.monotonic()
+            expired = [it for it in items
+                       if it[2] is not None and now >= it[2]]
+            items = [it for it in items
+                     if it[2] is None or now < it[2]]
+            if expired:
+                # count BEFORE failing: a caller woken by get() must see
+                # the eviction already reflected in stats()
+                with self._stats_lock:
+                    self.deadline_evictions += len(expired)
+            for _, obs, dl in expired:
+                obs._fail(DeadlineExpiredError(
+                    f"request deadline expired {now - dl:.3f}s before "
+                    "batch dispatch"))
+            if not items:
+                continue
             # what's STILL queued after this coalesce = the backlog a new
             # request joins; occupancy tells whether batching is working
             self._m_queue_depth.set(self._q.qsize())
@@ -563,15 +709,15 @@ class ParallelInference:
             xs = [i[0] for i in items]
             sizes = [len(x) for x in xs]
             with self._inflight_lock:
-                self._inflight = [obs for _, obs in items]
+                self._inflight = [obs for _, obs, _ in items]
             try:
                 out = self.output(np.concatenate(xs, axis=0))
                 ofs = 0
-                for (x, obs), n in zip(items, sizes):
+                for (x, obs, _), n in zip(items, sizes):
                     obs._resolve(out[ofs:ofs + n])
                     ofs += n
             except BaseException as e:
-                for _, obs in items:
+                for _, obs, _ in items:
                     obs._fail(e)
             finally:
                 with self._inflight_lock:

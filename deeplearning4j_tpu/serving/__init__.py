@@ -1,0 +1,20 @@
+"""Overload-safe model serving tier.
+
+An HTTP front-end over ``parallel.ParallelInference`` where robustness
+under overload is the headline: continuous batching into the pow2 bucket
+ladder, bounded admission with load shedding (429), per-request deadlines
+honored at batch formation (504 before dispatch, never a wasted batch
+slot), a per-model circuit breaker (fast 503 + half-open probing),
+graceful drain (zero dropped in-flight), warmup-gated readiness and a
+``/metrics`` scrape of every control point. See
+:mod:`~deeplearning4j_tpu.serving.server` for the route table and
+:mod:`~deeplearning4j_tpu.serving.breaker` for the breaker state machine.
+"""
+
+from deeplearning4j_tpu.serving.breaker import CircuitBreaker  # noqa: F401
+from deeplearning4j_tpu.serving.server import (  # noqa: F401
+    BreakerOpenError,
+    ModelDispatchError,
+    ModelEndpoint,
+    ModelServer,
+)

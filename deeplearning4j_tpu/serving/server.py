@@ -1,0 +1,556 @@
+"""Overload-safe HTTP model server in front of ``ParallelInference``.
+
+Parity surface: the reference ships real serving fronts (SURVEY §2.9
+``NearestNeighborsServer``, §2.10 the Play-based UI server); this is the
+model-inference analogue, stdlib ``ThreadingHTTPServer`` in the house
+style of ``clustering/server.py`` / ``ui/server.py``.
+
+Robustness under overload is the design center, not an adapter detail:
+
+- **Continuous batching** — every HTTP handler thread ``submit()``s into
+  one ``ParallelInference`` per model; its worker coalesces whatever is
+  queued at dispatch time into the pow2 bucket ladder. No fixed
+  microbatches: cross-client requests share device batches whenever they
+  overlap in the queue.
+- **Admission control / load shedding** — the per-model queue is BOUNDED
+  (``ParallelInference(queue_depth=...)``); over capacity the server
+  answers **429 + Retry-After immediately** instead of queueing without
+  limit. A burst beyond sustainable load degrades to fast rejections,
+  never to unbounded memory or forever-waiting clients.
+- **Deadlines** — each request carries ``deadline_ms`` (body field,
+  ``X-Deadline-Ms`` header, or the endpoint default); it propagates into
+  batch formation, where expired requests are evicted BEFORE device
+  dispatch and answered **504** — a request never occupies a batch slot
+  it cannot use.
+- **Circuit breaker** — a burst of model-dispatch failures OPENS the
+  per-model :class:`~deeplearning4j_tpu.serving.breaker.CircuitBreaker`;
+  while open the server answers **503 fast** with Retry-After, then
+  half-open probes feel for recovery.
+- **Graceful drain** — ``drain()`` (and ``stop()``) sheds new arrivals
+  with 503 while every in-flight request completes: zero dropped, which
+  also makes checkpoint hot-swap + restart under load safe end to end.
+- **Readiness** — ``/readyz`` stays 503 until every endpoint's warmup
+  ladder has compiled (no live request ever pays a multi-second XLA
+  compile); ``/healthz`` reports process liveness.
+- **Observability** — shed/expired/breaker counters, end-to-end
+  ``serving_request_ms``, in-flight gauge and per-``ParallelInference``
+  queue-depth/occupancy instruments all land in the obs registry,
+  scrapeable at this server's own ``/metrics``.
+
+Routes::
+
+    GET  /healthz                     process liveness (+ drain flag)
+    GET  /readyz                      200 only when warmed and not draining
+    GET  /metrics                     Prometheus exposition (obs registry)
+    GET  /v1/models                   model list + serving stats
+    GET  /v1/models/<name>            one model's stats (pi + breaker)
+    POST /v1/models/<name>:predict    {"inputs": [[...], ...],
+                                       "deadline_ms": 250}  (optional)
+
+Predict responses: 200 ``{"outputs": ...}``; 400 malformed; 404 unknown
+model; 413 oversized body; 429 shed (queue full); 503 breaker open or
+draining; 504 deadline expired — all errors are structured JSON with an
+``"error"`` message and a ``"reason"`` tag.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib.parse import urlparse
+
+import numpy as np
+
+from deeplearning4j_tpu.parallel.inference import (DeadlineExpiredError,
+                                                   ParallelInference,
+                                                   QueueFullError)
+from deeplearning4j_tpu.serving.breaker import CircuitBreaker
+from deeplearning4j_tpu.utils.http import parse_content_length
+
+log = logging.getLogger(__name__)
+
+__all__ = ["ModelEndpoint", "ModelServer", "BreakerOpenError",
+           "ModelDispatchError"]
+
+
+class BreakerOpenError(RuntimeError):
+    """The endpoint's circuit breaker is open (or probing): fast 503."""
+
+    def __init__(self, retry_after_s: float):
+        super().__init__("circuit breaker open")
+        self.retry_after_s = float(retry_after_s)
+
+
+class ModelDispatchError(RuntimeError):
+    """The model dispatch itself failed (counted against the breaker)."""
+
+
+class ModelEndpoint:
+    """One served model: a ``ParallelInference`` plus its admission,
+    deadline and breaker policy. Build through
+    :meth:`ModelServer.add_model` (which owns construction defaults), or
+    directly around an existing ``ParallelInference``."""
+
+    def __init__(self, name: str, pi: ParallelInference, *,
+                 default_deadline_ms: float = 1000.0,
+                 breaker: Optional[CircuitBreaker] = None,
+                 warmup_example=None, warmup_buckets=None,
+                 owns_pi: bool = False):
+        if pi.inference_mode != "batched":
+            raise ValueError(
+                f"endpoint '{name}' needs a batched-mode ParallelInference "
+                "(continuous batching is the serving contract)")
+        self.name = name
+        self.pi = pi
+        self.default_deadline_ms = float(default_deadline_ms)
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self.warmup_example = warmup_example
+        self.warmup_buckets = warmup_buckets
+        self.owns_pi = owns_pi
+        # feature-shape guard from the warmup example: a wrong-shaped
+        # request is a CLIENT error (400) and must never reach dispatch,
+        # where its failure would count against the model's breaker
+        self.feature_shape = (None if warmup_example is None
+                              else tuple(np.asarray(warmup_example).shape[1:]))
+        # warmed==True means /readyz may pass: either the ladder compiled
+        # or no example was given (caller accepts first-request compiles)
+        self.warmed = warmup_example is None
+        self._warmup_lock = threading.Lock()
+
+    def warmup(self):
+        """Compile the bucket ladder; flips the readiness gate."""
+        with self._warmup_lock:
+            if self.warmup_example is not None:
+                self.pi.warmup(self.warmup_example,
+                               buckets=self.warmup_buckets)
+            self.warmed = True
+        return self
+
+    def predict(self, arr: np.ndarray,
+                deadline_ms: Optional[float] = None) -> np.ndarray:
+        """Admission → (deadline-aware) batch formation → dispatch.
+        Raises the typed errors the HTTP layer maps to 429/503/504/500."""
+        if not self.breaker.allow():
+            raise BreakerOpenError(self.breaker.retry_after())
+        dl_ms = (self.default_deadline_ms if deadline_ms is None
+                 else float(deadline_ms))
+        deadline = (time.monotonic() + dl_ms / 1000.0
+                    if dl_ms and dl_ms > 0 else None)
+        obs = self.pi.submit(arr, deadline=deadline)  # QueueFullError ⇒ 429
+        try:
+            # the extra beat past the deadline covers a batch already ON
+            # the device when the deadline passed — eviction only happens
+            # at batch formation, so a dispatched request may still answer
+            out = obs.get(timeout=(dl_ms / 1000.0 + 5.0)
+                          if deadline is not None else None)
+        except DeadlineExpiredError:
+            raise
+        except TimeoutError:
+            # result never materialized inside deadline + slack: to the
+            # client this is the same 504; not proven to be a model fault,
+            # so it does not feed the breaker
+            raise DeadlineExpiredError(
+                "result not ready within deadline (+5s dispatch slack)")
+        except BaseException as e:
+            self.breaker.record_failure()
+            raise ModelDispatchError(f"{type(e).__name__}: {e}") from e
+        self.breaker.record_success()
+        if self.feature_shape is None:
+            # learned from the first success: later wrong-shaped requests
+            # become 400s at the guard instead of dispatch failures that
+            # count against the model's breaker
+            self.feature_shape = tuple(arr.shape[1:])
+        if deadline is not None and time.monotonic() > deadline:
+            # dispatched in time but finished late (e.g. a slow batch
+            # already on the device when the deadline passed): the answer
+            # is worthless to the caller — 504, so a 200 ALWAYS means the
+            # deadline was met (the model stays healthy: no breaker hit)
+            raise DeadlineExpiredError("result completed after the "
+                                       "deadline; discarded")
+        return out
+
+    def stats(self) -> dict:
+        st = self.pi.stats()
+        return {
+            "requests_served": st["requests_served"],
+            "batches_dispatched": st["batches_dispatched"],
+            "queue": st["queue"],
+            "batch_size": st["batch_size"],
+            "hot_swap": st["hot_swap"],
+            "warmed": self.warmed,
+            "breaker": self.breaker.as_dict(),
+            "default_deadline_ms": self.default_deadline_ms,
+        }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_ref: Optional["ModelServer"] = None
+    # slow-client guard: a peer that stops sending mid-request times out
+    # and frees its handler thread instead of holding it forever
+    timeout = 30.0
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _body(self, body: bytes, content_type: str, code: int = 200,
+              retry_after_s: Optional[float] = None):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        if retry_after_s is not None:
+            self.send_header("Retry-After",
+                             str(max(1, math.ceil(retry_after_s))))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client gave up; the server must not care
+
+    def _json(self, obj, code: int = 200,
+              retry_after_s: Optional[float] = None):
+        self._body(json.dumps(obj).encode(), "application/json", code,
+                   retry_after_s=retry_after_s)
+
+    def _error(self, code: int, reason: str, message: str,
+               retry_after_s: Optional[float] = None):
+        self._json({"error": message, "reason": reason}, code,
+                   retry_after_s=retry_after_s)
+
+    # ----------------------------------------------------------------- GET
+    def do_GET(self):
+        srv = type(self).server_ref
+        path = urlparse(self.path).path
+        if path == "/healthz":
+            self._json({"ok": True, "draining": srv.draining,
+                        "models": sorted(srv.endpoints)})
+        elif path == "/readyz":
+            ready, reasons = srv.readiness()
+            if ready:
+                self._json({"ready": True})
+            else:
+                self._json({"ready": False, "reasons": reasons}, 503)
+        elif path == "/metrics":
+            from deeplearning4j_tpu.obs.exporters import prometheus_text
+            self._body(prometheus_text().encode(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/v1/models":
+            self._json({"models": {n: ep.stats()
+                                   for n, ep in srv.endpoints.items()}})
+        elif path.startswith("/v1/models/"):
+            name = path[len("/v1/models/"):]
+            ep = srv.endpoints.get(name)
+            if ep is None:
+                self._error(404, "unknown_model", f"no model '{name}'")
+            else:
+                self._json({"model": name, **ep.stats()})
+        else:
+            self._error(404, "not_found", "not found")
+
+    # ---------------------------------------------------------------- POST
+    def do_POST(self):
+        srv = type(self).server_ref
+        path = urlparse(self.path).path
+        if not (path.startswith("/v1/models/") and path.endswith(":predict")):
+            self._error(404, "not_found", "not found")
+            return
+        name = path[len("/v1/models/"):-len(":predict")]
+        ep = srv.endpoints.get(name)
+        if ep is None:
+            self._error(404, "unknown_model", f"no model '{name}'")
+            return
+        length, err = parse_content_length(self.headers, srv.max_body_bytes)
+        if err is not None:
+            code, message = err
+            self._error(code, "bad_request" if code == 400
+                        else "body_too_large", message)
+            return
+        srv._m_requests.inc()
+        # drain check + in-flight enter are ATOMIC: drain() observes a
+        # complete count — a request is either shed or tracked, never
+        # silently in between
+        if not srv._enter_request():
+            srv._m_drain_rejected.inc()
+            self._error(503, "draining",
+                        "server is draining; retry against another replica",
+                        retry_after_s=srv.retry_after_s)
+            return
+        t0 = time.perf_counter()
+        try:
+            try:
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+                arr = np.asarray(body["inputs"], dtype=np.float32)
+                deadline_ms = body.get(
+                    "deadline_ms", self.headers.get("X-Deadline-Ms"))
+                if deadline_ms is not None:
+                    deadline_ms = float(deadline_ms)
+            except KeyError:
+                self._error(400, "bad_request", "body needs an 'inputs' "
+                            "array: {\"inputs\": [[...], ...]}")
+                return
+            except (ValueError, TypeError) as e:
+                self._error(400, "bad_request", f"malformed request: {e}")
+                return
+            if arr.ndim < 2 or arr.shape[0] < 1:
+                self._error(400, "bad_request",
+                            "'inputs' needs a leading batch axis "
+                            f"(got shape {arr.shape})")
+                return
+            if ep.feature_shape is not None and \
+                    tuple(arr.shape[1:]) != ep.feature_shape:
+                self._error(400, "bad_request",
+                            f"model '{name}' takes features of shape "
+                            f"{ep.feature_shape}; got {tuple(arr.shape[1:])}")
+                return
+            try:
+                out = ep.predict(arr, deadline_ms=deadline_ms)
+            except QueueFullError as e:
+                srv._m_shed.inc()
+                self._error(429, "shed", str(e),
+                            retry_after_s=srv.retry_after_s)
+                return
+            except BreakerOpenError as e:
+                srv._m_breaker_rejected.inc()
+                self._error(503, "breaker_open",
+                            f"model '{name}' is failing; breaker open",
+                            retry_after_s=e.retry_after_s)
+                return
+            except DeadlineExpiredError as e:
+                srv._m_expired.inc()
+                srv._m_request_ms.observe((time.perf_counter() - t0) * 1e3)
+                self._error(504, "deadline_expired", str(e))
+                return
+            except ModelDispatchError as e:
+                srv._m_errors.inc()
+                self._error(500, "dispatch_failed",
+                            f"inference failed: {e}")
+                return
+            srv._m_request_ms.observe((time.perf_counter() - t0) * 1e3)
+            self._json({
+                "model": name,
+                "outputs": np.asarray(out).tolist(),
+                "checkpoint_step": ep.pi.current_checkpoint_step,
+            })
+        finally:
+            srv._exit_request()
+
+
+class ModelServer:
+    """Multi-model HTTP serving front (see module docstring).
+
+    ``ModelServer({"iris": net}).start()`` builds a batched
+    ``ParallelInference`` per model (bounded queue, immediate shed) and
+    serves them behind one port; pass a ``ParallelInference`` instead of
+    a model to control batching/bucketing/hot-swap yourself, or a
+    :class:`ModelEndpoint` to control everything."""
+
+    def __init__(self, models: Optional[Dict[str, object]] = None, *,
+                 port: int = 0, bind_address: str = "127.0.0.1",
+                 max_body_bytes: int = 8 << 20,
+                 default_deadline_ms: float = 1000.0,
+                 retry_after_s: float = 1.0,
+                 queue_depth: int = 256, batch_limit: int = 32):
+        # loopback by default, like the UI/kNN servers: exposing an
+        # unauthenticated predict endpoint beyond the host is an opt-in
+        self.port = port
+        self.bind_address = bind_address
+        self.max_body_bytes = int(max_body_bytes)
+        self.default_deadline_ms = float(default_deadline_ms)
+        self.retry_after_s = float(retry_after_s)
+        self._default_queue_depth = int(queue_depth)
+        self._default_batch_limit = int(batch_limit)
+        self.endpoints: Dict[str, ModelEndpoint] = {}
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._warmup_thread: Optional[threading.Thread] = None
+        self._state = threading.Condition()
+        self._inflight = 0
+        self._draining = False
+        from deeplearning4j_tpu.obs.registry import (absorb_model_server,
+                                                     get_registry)
+        reg = get_registry()
+        self._m_requests = reg.counter(
+            "serving_http_requests", unit="requests",
+            help="predict requests received over HTTP")
+        self._m_shed = reg.counter(
+            "serving_requests_shed", unit="requests",
+            help="requests shed with 429 because the bounded admission "
+                 "queue was full (load shedding, never unbounded growth)")
+        self._m_expired = reg.counter(
+            "serving_requests_expired", unit="requests",
+            help="requests answered 504 after their deadline expired "
+                 "(evicted before device dispatch)")
+        self._m_breaker_rejected = reg.counter(
+            "serving_breaker_rejected", unit="requests",
+            help="requests answered 503 fast while a model's circuit "
+                 "breaker was open")
+        self._m_drain_rejected = reg.counter(
+            "serving_drain_rejected", unit="requests",
+            help="requests shed with 503 while the server drained")
+        self._m_errors = reg.counter(
+            "serving_request_errors", unit="requests",
+            help="predict requests that failed in model dispatch (500)")
+        self._m_request_ms = reg.histogram(
+            "serving_request_ms", unit="ms",
+            help="end-to-end HTTP predict latency for admitted requests "
+                 "(queue wait + batch formation + dispatch)")
+        self._m_inflight = reg.gauge(
+            "serving_inflight_requests", unit="requests",
+            help="predict requests currently inside the server "
+                 "(admitted, not yet answered)")
+        absorb_model_server(reg, self)
+        for name, m in (models or {}).items():
+            self.add_model(name, m)
+
+    # ---------------------------------------------------------- model mgmt
+    def add_model(self, name: str, model, *, warmup_example=None,
+                  warmup_buckets=None, breaker: Optional[CircuitBreaker]
+                  = None, default_deadline_ms: Optional[float] = None,
+                  queue_depth: Optional[int] = None,
+                  batch_limit: Optional[int] = None,
+                  fold_bn: bool = False, checkpoint_manager=None,
+                  checkpoint_poll_secs: Optional[float] = None
+                  ) -> ModelEndpoint:
+        """Register a model (several nets behind one server, each with its
+        own ``ParallelInference``, queue and breaker)."""
+        if name in self.endpoints:
+            raise ValueError(f"model '{name}' already registered")
+        if isinstance(model, ModelEndpoint):
+            ep = model
+            ep.name = name
+        elif isinstance(model, ParallelInference):
+            ep = ModelEndpoint(
+                name, model, warmup_example=warmup_example,
+                warmup_buckets=warmup_buckets, breaker=breaker,
+                default_deadline_ms=(self.default_deadline_ms
+                                     if default_deadline_ms is None
+                                     else default_deadline_ms))
+        else:
+            pi = ParallelInference(
+                model,
+                batch_limit=(self._default_batch_limit if batch_limit is None
+                             else batch_limit),
+                queue_depth=(self._default_queue_depth if queue_depth is None
+                             else queue_depth),
+                queue_put_timeout_ms=0.0,  # over capacity ⇒ IMMEDIATE 429
+                fold_bn=fold_bn, checkpoint_manager=checkpoint_manager,
+                checkpoint_poll_secs=checkpoint_poll_secs)
+            ep = ModelEndpoint(
+                name, pi, warmup_example=warmup_example,
+                warmup_buckets=warmup_buckets, breaker=breaker,
+                default_deadline_ms=(self.default_deadline_ms
+                                     if default_deadline_ms is None
+                                     else default_deadline_ms),
+                owns_pi=True)
+        self.endpoints[name] = ep
+        return ep
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self, warmup: bool = True,
+              warmup_async: bool = True) -> "ModelServer":
+        """Bind and serve. ``warmup=True`` compiles every endpoint's
+        bucket ladder (async by default — the port answers immediately,
+        ``/readyz`` flips to 200 when compilation finishes; pass
+        ``warmup_async=False`` to block until ready)."""
+        handler = type("BoundServingHandler", (_Handler,),
+                       {"server_ref": self})
+        self._httpd = ThreadingHTTPServer((self.bind_address, self.port),
+                                          handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="model-server", daemon=True)
+        self._thread.start()
+        if warmup:
+            if warmup_async:
+                self._warmup_thread = threading.Thread(
+                    target=self.warmup, name="serving-warmup", daemon=True)
+                self._warmup_thread.start()
+            else:
+                self.warmup()
+        return self
+
+    def warmup(self):
+        """Compile every endpoint's warmup ladder (gates ``/readyz``)."""
+        for ep in list(self.endpoints.values()):
+            try:
+                ep.warmup()
+            except Exception:
+                log.exception("warmup failed for model '%s'; endpoint "
+                              "stays not-ready", ep.name)
+        return self
+
+    def readiness(self):
+        unwarmed = sorted(n for n, ep in self.endpoints.items()
+                          if not ep.warmed)
+        reasons = []
+        if unwarmed:
+            reasons.append(f"warmup pending: {unwarmed}")
+        if self.draining:
+            reasons.append("draining")
+        return (not reasons, reasons)
+
+    @property
+    def draining(self) -> bool:
+        with self._state:
+            return self._draining
+
+    @property
+    def inflight(self) -> int:
+        with self._state:
+            return self._inflight
+
+    def _enter_request(self) -> bool:
+        with self._state:
+            if self._draining:
+                return False
+            self._inflight += 1
+            self._m_inflight.set(self._inflight)
+            return True
+
+    def _exit_request(self):
+        with self._state:
+            self._inflight -= 1
+            self._m_inflight.set(self._inflight)
+            self._state.notify_all()
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful drain: new arrivals shed (503), every in-flight
+        request completes — zero dropped. Returns whether in-flight hit
+        zero inside the timeout. Idempotent; ``undrain()`` reverses it
+        (e.g. after a hot-swap rollout step)."""
+        deadline = time.monotonic() + timeout_s
+        with self._state:
+            self._draining = True
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._state.wait(remaining)
+            return True
+
+    def undrain(self):
+        with self._state:
+            self._draining = False
+
+    def stop(self, drain: bool = True, drain_timeout_s: float = 30.0):
+        """Drain (unless told not to), stop the listener, shut down every
+        endpoint's ``ParallelInference`` this server built."""
+        if drain:
+            self.drain(timeout_s=drain_timeout_s)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        for ep in self.endpoints.values():
+            if ep.owns_pi:
+                ep.pi.shutdown()
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.bind_address}:{self.port}"

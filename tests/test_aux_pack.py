@@ -241,6 +241,50 @@ def test_nearest_neighbors_server():
         srv.stop()
 
 
+def test_nearest_neighbors_server_rejects_oversized_body():
+    """Body-size hardening: an oversized POST is a structured 413 answered
+    from the Content-Length header alone — the payload is never read into
+    server memory."""
+    import urllib.error
+    pts = np.random.default_rng(3).standard_normal((10, 3))
+    srv = NearestNeighborsServer(pts, max_body_bytes=256).start(port=0)
+    try:
+        base = f"http://localhost:{srv.port}"
+        big = json.dumps({"ndarray": [0.0] * 5000, "k": 1}).encode()
+        req = urllib.request.Request(base + "/knnnew", data=big)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 413
+        err = json.loads(ei.value.read())
+        assert "exceeds" in err["error"]
+        # the server is still healthy for well-sized queries
+        ok = urllib.request.Request(
+            base + "/knnnew",
+            data=json.dumps({"ndarray": [0.0, 0.0, 0.0], "k": 2}).encode())
+        res = json.loads(urllib.request.urlopen(ok, timeout=10).read())
+        assert len(res["results"]) == 2
+    finally:
+        srv.stop()
+
+
+def test_nearest_neighbors_server_malformed_bodies_are_structured_400():
+    """Malformed POSTs (non-JSON, non-object JSON) come back as JSON 400s
+    instead of raising in the handler."""
+    import urllib.error
+    pts = np.random.default_rng(4).standard_normal((8, 2))
+    srv = NearestNeighborsServer(pts).start(port=0)
+    try:
+        base = f"http://localhost:{srv.port}"
+        for payload in (b"definitely not json", b"[1, 2, 3]"):
+            req = urllib.request.Request(base + "/knn", data=payload)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 400
+            assert "error" in json.loads(ei.value.read())
+    finally:
+        srv.stop()
+
+
 def test_model_guesser(tmp_path):
     """reference ModelGuesser.loadModelGuess/loadConfigGuess."""
     from deeplearning4j_tpu.utils.model_guesser import (load_config_guess,

@@ -436,6 +436,54 @@ class TestMetricRegistration:
         """) == []
 
 
+class TestUnboundedQueue:
+    def test_fires_on_unbounded_queue_in_parallel_path(self):
+        vs = _lint("""
+            import queue
+            class W:
+                def __init__(self):
+                    self._q = queue.Queue()
+        """, path="deeplearning4j_tpu/parallel/thing.py")
+        assert _rules(vs) == ["DLT008"]
+        assert "unbounded" in vs[0].message and "maxsize" in vs[0].message
+
+    def test_fires_on_maxsize_zero_and_from_import(self):
+        vs = _lint("""
+            from queue import Queue
+            def make():
+                return Queue(maxsize=0)
+        """, path="deeplearning4j_tpu/serving/thing.py")
+        assert _rules(vs) == ["DLT008"]
+
+    def test_fires_on_positional_zero(self):
+        vs = _lint("""
+            import queue
+            q = queue.Queue(0)
+        """, path="deeplearning4j_tpu/datasets/thing.py")
+        assert _rules(vs) == ["DLT008"]
+
+    def test_bounded_queue_clean(self):
+        assert _lint("""
+            import queue
+            from queue import Queue
+            a = queue.Queue(maxsize=64)
+            b = Queue(8)
+            c = queue.Queue(maxsize=depth)
+        """, path="deeplearning4j_tpu/storage/thing.py") == []
+
+    def test_out_of_scope_path_clean(self):
+        assert _lint("""
+            import queue
+            q = queue.Queue()
+        """, path="deeplearning4j_tpu/nn/thing.py") == []
+
+    def test_inline_waiver(self):
+        assert _lint("""
+            import queue
+            q = queue.Queue()  # lint: disable=DLT008 (drained every step)
+        """, path="deeplearning4j_tpu/parallel/thing.py") == []
+
+
 class TestFileWaiver:
     def test_disable_file(self):
         vs = _lint("""

@@ -360,6 +360,89 @@ def test_parallel_inference_dynamic_batching():
     assert pi_seq.batches_dispatched == 0  # no worker involved
 
 
+def _stalled_inference(seed=21, queue_depth=3, queue_put_timeout_ms=30):
+    """A ParallelInference whose model forward is HELD at a gate — the
+    stalled-worker scenario the bounded queue exists for. Returns
+    (pi, gate, entered): set `gate` to release, wait `entered` to know
+    the worker is wedged inside a dispatch."""
+    import threading as _threading
+
+    net = _net(seed=seed)
+    gate = _threading.Event()
+    entered = _threading.Event()
+    orig_output = net.output
+
+    def gated_output(arr):
+        entered.set()
+        assert gate.wait(30), "test gate leaked shut"
+        return orig_output(arr)
+
+    net.output = gated_output  # instance attribute shadows the method
+    pi = ParallelInference(net, queue_depth=queue_depth,
+                           queue_put_timeout_ms=queue_put_timeout_ms)
+    return pi, gate, entered
+
+
+def test_parallel_inference_bounded_queue_sheds_when_stalled():
+    """Regression for the unbounded-queue bug: a stalled worker cannot
+    grow the queue past queue_depth — the overflow submit raises typed
+    QueueFullError within the put timeout (block-with-timeout semantics),
+    and the rejection is surfaced in stats()."""
+    import time as _time
+
+    from deeplearning4j_tpu.parallel import QueueFullError
+
+    pi, gate, entered = _stalled_inference(queue_depth=3)
+    try:
+        x = np.zeros((1, 4), np.float32)
+        first = pi.submit(x)
+        assert entered.wait(10)  # worker is wedged inside the dispatch
+        queued = [pi.submit(x) for _ in range(3)]  # exactly fills the bound
+        t0 = _time.perf_counter()
+        with pytest.raises(QueueFullError, match="queue_depth=3"):
+            pi.submit(x)
+        assert _time.perf_counter() - t0 < 5.0  # shed fast, not hung
+        assert pi._q.qsize() == 3  # the queue never grew past its bound
+        st = pi.stats()
+        assert st["queue"] == {"depth": 3, "size": 3,
+                               "rejected": 1, "expired": 0}
+        gate.set()  # drain: everything accepted is served
+        assert first.get(timeout=30).shape == (1, 3)
+        for obs in queued:
+            assert obs.get(timeout=30).shape == (1, 3)
+        assert pi.stats()["queue"]["size"] == 0
+    finally:
+        gate.set()
+        pi.shutdown()
+
+
+def test_parallel_inference_deadline_evicted_before_dispatch():
+    """submit(deadline=...) contract: a request whose deadline expires
+    while queued behind a stalled batch is failed at batch formation
+    (DeadlineExpiredError) and never dispatched."""
+    import time as _time
+
+    from deeplearning4j_tpu.parallel import DeadlineExpiredError
+
+    pi, gate, entered = _stalled_inference(queue_depth=8)
+    try:
+        x = np.zeros((2, 4), np.float32)
+        patient = pi.submit(x)
+        assert entered.wait(10)
+        doomed = pi.submit(x, deadline=_time.monotonic() + 0.05)
+        _time.sleep(0.25)  # the deadline passes while it sits queued
+        gate.set()
+        assert patient.get(timeout=30).shape == (2, 3)
+        with pytest.raises(DeadlineExpiredError):
+            doomed.get(timeout=30)
+        st = pi.stats()
+        assert st["queue"]["expired"] == 1
+        assert st["batches_dispatched"] == 1  # the doomed one never ran
+    finally:
+        gate.set()
+        pi.shutdown()
+
+
 # --------------------------------------------------- all-to-all (Ulysses) SP
 @pytest.mark.parametrize("causal", [False, True])
 def test_ulysses_attention_matches_reference(devices, causal):

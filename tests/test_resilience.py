@@ -617,7 +617,9 @@ class TestHotSwap:
         st0 = pi.stats()
         assert st0["hot_swap"] == {"enabled": True, "swaps": 0,
                                    "current_checkpoint_step": 5,
-                                   "poll_errors": 0}
+                                   "poll_errors": 0,
+                                   "consecutive_poll_errors": 0,
+                                   "last_poll_delay_s": None}
 
         errors, served_count = [], [0]
         stop = threading.Event()
@@ -708,6 +710,75 @@ class TestHotSwap:
         pi.shutdown()
         trainer_cm.close()
         serve_cm.close()
+
+    def test_poll_backoff_schedule_is_capped_exponential(self, devices):
+        """_next_poll_delay: healthy → the configured cadence; erroring →
+        cadence + capped-exponential-jitter backoff (utils/backoff.py),
+        non-decreasing in the error streak, capped, reset on success."""
+        store = {}
+        _, trainer_cm, _, serve_cm, served = self._serving_stack(store)
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+        pi = ParallelInference(served)
+        assert pi._next_poll_delay(0.5, 0) == 0.5
+        delays = [pi._next_poll_delay(0.5, k, cap_s=8.0)
+                  for k in range(1, 9)]
+        assert all(d > 0.5 for d in delays)
+        # jitter draws from [d/2, d] with d doubling per streak step, so
+        # the schedule's LOWER bound is non-decreasing and the cap binds
+        for k, d in enumerate(delays, start=1):
+            full = min(8.0, 0.5 * 2.0 ** (k - 1))
+            assert 0.5 + full / 2 <= d <= 0.5 + full, (k, d)
+        assert max(delays) <= 0.5 + 8.0  # capped, never minutes-long
+        pi.shutdown()
+        trainer_cm.close()
+        serve_cm.close()
+
+    def test_poller_backs_off_on_flaky_store_and_recovers(self, devices):
+        """Satellite acceptance: a scripted FlakyBackend makes every poll
+        fail — the poller counts errors, stretches its cadence, keeps
+        serving, and once the store heals it resets and swaps in the
+        newer checkpoint."""
+        store = {}
+        batches, trainer_cm, net, serve_cm, served = \
+            self._serving_stack(store)
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+        # the SERVING manager's storage becomes flaky mid-flight: wrap
+        # reads via a fresh manager over a FlakyBackend on the same bucket
+        flaky = FlakyBackend(ObjectStoreBackend(store),
+                             ops=("get", "list"))
+        flaky_cm = CheckpointManager(storage=flaky)
+        pi = ParallelInference(served)
+        pi.start_hot_swap(flaky_cm, poll_secs=0.02)
+        flaky.script_failures(3)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            hs = pi.stats()["hot_swap"]
+            if hs["poll_errors"] >= 3:
+                break
+            time.sleep(0.02)
+        hs = pi.stats()["hot_swap"]
+        assert hs["poll_errors"] == 3
+        assert hs["last_poll_delay_s"] > 0.02  # backed off the cadence
+        assert pi.output(np.asarray(batches[0].features[:2])).shape == (2, 3)
+        # the store heals; a newer checkpoint commits; the poller resets
+        # its streak and picks the swap up on its own
+        net.fit(batches, num_epochs=2)
+        trainer_cm.save(net)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            hs = pi.stats()["hot_swap"]
+            if hs["swaps"] >= 1 and hs["consecutive_poll_errors"] == 0:
+                break
+            time.sleep(0.02)
+        hs = pi.stats()["hot_swap"]
+        assert hs["swaps"] == 1
+        assert hs["current_checkpoint_step"] == 15
+        assert hs["consecutive_poll_errors"] == 0  # reset on success
+        assert flaky.faults_injected == 3  # the chaos actually happened
+        pi.shutdown()
+        trainer_cm.close()
+        serve_cm.close()
+        flaky_cm.close()
 
     def test_architecture_mismatch_refuses_to_swap(self, devices):
         store = {}

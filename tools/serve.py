@@ -1,0 +1,114 @@
+"""Serve checkpointed models over HTTP (serving.ModelServer CLI).
+
+The deployment shape the serving tier was built for: restore each model's
+latest committed checkpoint, put it behind the overload-safe front-end,
+and keep polling the store so a training job's newer commits hot-swap in
+live (zero dropped requests, no recompiles).
+
+    python tools/serve.py --model iris=/ckpts/iris --model mnist=/ckpts/mnist \
+        --port 9100 --poll-secs 10 --queue-depth 256 --deadline-ms 250
+
+Then::
+
+    curl -s localhost:9100/readyz
+    curl -s -X POST localhost:9100/v1/models/iris:predict \
+        -d '{"inputs": [[5.1, 3.5, 1.4, 0.2]], "deadline_ms": 100}'
+    curl -s localhost:9100/metrics | grep serving_
+
+Admission (429), deadlines (504), breaker (503), drain-on-SIGTERM and
+warmup-gated /readyz all per deeplearning4j_tpu/serving/server.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _parse_model(spec: str):
+    name, sep, path = spec.partition("=")
+    if not sep or not name or not path:
+        raise argparse.ArgumentTypeError(
+            f"--model takes name=checkpoint_dir; got {spec!r}")
+    return name, path
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--model", action="append", type=_parse_model,
+                   required=True, metavar="NAME=CKPT_DIR",
+                   help="model name + checkpoint directory (repeatable: "
+                        "several nets behind one server)")
+    p.add_argument("--port", type=int, default=9100)
+    p.add_argument("--bind", default="127.0.0.1",
+                   help="bind address (default loopback; 0.0.0.0 to "
+                        "serve remotely)")
+    p.add_argument("--queue-depth", type=int, default=256,
+                   help="admission queue bound per model (full ⇒ 429)")
+    p.add_argument("--deadline-ms", type=float, default=1000.0,
+                   help="default per-request deadline")
+    p.add_argument("--batch-limit", type=int, default=32,
+                   help="max coalesced requests per dispatch")
+    p.add_argument("--poll-secs", type=float, default=None,
+                   help="checkpoint hot-swap poll cadence (off when unset)")
+    p.add_argument("--fold-bn", action="store_true",
+                   help="serve BN-folded copies (perf.fusion.fold_bn)")
+    p.add_argument("--drain-timeout-s", type=float, default=30.0)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from deeplearning4j_tpu.checkpoint import CheckpointManager
+    from deeplearning4j_tpu.serving import ModelServer
+
+    server = ModelServer(port=args.port, bind_address=args.bind,
+                         default_deadline_ms=args.deadline_ms,
+                         queue_depth=args.queue_depth,
+                         batch_limit=args.batch_limit)
+    managers = []
+    for name, ckpt_dir in args.model:
+        cm = CheckpointManager(ckpt_dir)
+        managers.append(cm)
+        net = cm.restore_latest(load_updater=False)
+        if net is None:
+            print(f"error: no restorable checkpoint in {ckpt_dir!r} "
+                  f"for model '{name}'", file=sys.stderr)
+            return 2
+        server.add_model(name, net, fold_bn=args.fold_bn,
+                         checkpoint_manager=cm,
+                         checkpoint_poll_secs=args.poll_secs)
+        step = net._restored_from.step
+        print(f"model '{name}': serving checkpoint step {step} "
+              f"from {ckpt_dir}", flush=True)
+
+    server.start(warmup=False)  # no example shape on file: first-request
+    print(f"serving {len(server.endpoints)} model(s) on "
+          f"{server.address} (hot-swap "
+          f"{'every %gs' % args.poll_secs if args.poll_secs else 'off'})",
+          flush=True)
+
+    done = threading.Event()
+
+    def _shutdown(signum, frame):
+        print(f"signal {signum}: draining "
+              f"({server.inflight} in flight)...", flush=True)
+        done.set()
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)
+    done.wait()
+    server.stop(drain=True, drain_timeout_s=args.drain_timeout_s)
+    for cm in managers:
+        cm.close()
+    print("drained and stopped.", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
