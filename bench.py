@@ -61,6 +61,8 @@ NOMINAL = {
     "checkpoint": 1_000.0,  # steps/sec, nominal small-model step loop
     "resilience": 100.0,    # ms, nominal small-model restore/swap budget
     "elastic": 1_000.0,     # ms, nominal membership-transition budget
+    "compression": 4.0,     # x, byte-reduction bar for the default
+                            # threshold policy (the DCN-win acceptance)
 }
 
 
@@ -820,6 +822,116 @@ def bench_resilience():
               "quiet full runs.")
 
 
+def bench_grad_compression():
+    """Compressed gradient collectives (parallel/compress.py): step time +
+    compression ratio + est. bytes-on-wire for dense vs threshold vs top-k
+    vs int8 on the zoo LeNet CNN and the charRNN (tBPTT path). The ratio
+    is ANALYTIC accounting of the wire format (what a cross-slice DCN
+    all-reduce would move); the step time shows what the in-step encode/
+    decode costs on top — on this CPU container both are metrics only per
+    the 9p/bench-sensitivity note (the compute-cost story belongs to a
+    quiet TPU run), but the byte-reduction ratio is shape-derived and
+    stable anywhere. Also probes the isolated compression pass into the
+    ``grad_compress_ms`` histogram (obs/)."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models import LeNet, TextGenerationLSTM
+    from deeplearning4j_tpu.parallel.compress import (
+        Int8Compression, ThresholdCompression, TopKCompression,
+        compression_stats, enable_grad_compression,
+        measure_compression_overhead)
+
+    if QUICK:
+        steps, cnn_batch, vocab, rnn_batch, seq = 4, 8, 16, 4, 16
+    else:
+        steps, cnn_batch, vocab, rnn_batch, seq = 20, 64, 47, 32, 100
+    rng = np.random.default_rng(5)
+
+    def lenet_batches():
+        x = rng.standard_normal((cnn_batch, 28 * 28)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, cnn_batch)]
+        return [DataSet(x, y)] * steps
+
+    def charrnn_batches():
+        ids = rng.integers(0, vocab, (rnn_batch, seq))
+        x = np.eye(vocab, dtype=np.float32)[ids]
+        y = np.eye(vocab, dtype=np.float32)[
+            rng.integers(0, vocab, (rnn_batch, seq))]
+        # T > tbptt_fwd_length: fit() runs the per-window tBPTT step, the
+        # compressed path for sequence models
+        return [DataSet(x, y)]
+
+    models = (
+        ("lenet", lambda: LeNet(num_classes=10).init(), lenet_batches()),
+        ("charrnn",
+         lambda: TextGenerationLSTM(total_unique_characters=vocab,
+                                    units=32 if QUICK else 256,
+                                    tbptt_length=seq // 2).init(),
+         charrnn_batches()),
+    )
+    schemes = (
+        ("dense", None),
+        ("threshold", ThresholdCompression()),  # the DEFAULT policy: the
+        # acceptance ratio is measured exactly here
+        ("topk", TopKCompression(ratio=0.01)),
+        ("int8", Int8Compression()),
+    )
+    for model_name, make_net, batches in models:
+        results = {}
+        threshold_ratio = None
+        for scheme_name, scheme in schemes:
+            net = make_net()
+            if scheme is not None:
+                enable_grad_compression(net, scheme)
+            net.fit(batches[:1])  # compile + warmup
+            float(net._score)
+
+            def timed(n=net):
+                sw = Stopwatch().start()
+                n.fit(batches)
+                return sw.stop(sync=n._score)
+
+            dt = _best_of(timed)
+            entry = {"steps_per_sec": round(len(batches) *
+                                            _windows_per_batch(net, batches)
+                                            / dt, 1)}
+            if scheme is not None:
+                st = compression_stats(net)
+                per_step_dense = st["dense_bytes"] / st["steps"]
+                per_step_wire = st["wire_bytes"] / st["steps"]
+                entry.update(
+                    ratio=round(st["dense_bytes"] / max(st["wire_bytes"], 1.0),
+                                1),
+                    dense_kb_per_step=round(per_step_dense / 1024.0, 1),
+                    wire_kb_per_step=round(per_step_wire / 1024.0, 1),
+                    residual_norm=round(st["residual_norm"], 4))
+                if "tau" in st:
+                    entry["tau"] = round(st["tau"], 6)
+                if scheme_name == "threshold":
+                    threshold_ratio = entry["ratio"]
+                    entry["grad_compress_ms"] = round(
+                        measure_compression_overhead(net), 3)
+            results[scheme_name] = entry
+        emit(f"grad_compression_{model_name}_threshold_byte_reduction_x",
+             float(threshold_ratio), "x", "compression",
+             schemes=results,
+             note="est. bytes-on-wire reduction of the DEFAULT threshold "
+                  "policy (DL4J dual sparse/bitmap accounting) vs the "
+                  "dense f32 all-reduce; per-scheme step rates are "
+                  "metrics-only on this host per the 9p note — the "
+                  "acceptance bar is the ratio (>= 4x). " + _REPS_NOTE)
+
+
+def _windows_per_batch(net, batches) -> int:
+    """Optimizer steps one DataSet triggers: tBPTT batches advance one
+    step per window, everything else one per batch."""
+    conf = net.conf
+    if getattr(conf, "backprop_type", "standard") != "tbptt":
+        return 1
+    T = batches[0].features.shape[1]
+    L = conf.tbptt_fwd_length
+    return max(1, -(-T // L))
+
+
 def bench_elastic():
     """Elastic-training path costs, metrics only (no thresholds — the 9p
     filesystem's fsync jitter swings disk-backed numbers run to run;
@@ -925,6 +1037,7 @@ def main():
                ("checkpoint", bench_checkpoint),
                ("resilience", bench_resilience),
                ("elastic", bench_elastic),
+               ("grad_compression", bench_grad_compression),
                ("resnet50_fusion", bench_resnet50_fusion),
                ("resnet50", bench_resnet50)]
     only = os.environ.get("BENCH_ONLY")

@@ -62,6 +62,16 @@ Rules (waivable per line with ``# lint: disable=DLT00X`` or per file with
   explicit full-queue semantics, e.g. ``ParallelInference``'s
   block-with-timeout ⇒ ``QueueFullError``), or waive inline like DLT003.
 
+- **DLT009 host-work-in-compression-path**: gradient compress/encode/
+  decode paths run INSIDE the traced train step (parallel/compress.py) —
+  host-side work there (``np.*`` calls, ``.item()``, ``jax.device_get``)
+  forces a host-device sync per step, exactly the pipeline collapse the
+  compressed collective exists to avoid. Scope: functions whose name
+  contains ``compress`` (or any method of a class named ``*Compression*``)
+  that ALSO use ``jnp``/``jax`` device math — mixed host+device code in a
+  compression path; pure-host readers (scrape-time absorbers with no jnp)
+  are exempt by construction. Waivable inline like DLT003.
+
 Adding a rule: write a ``_rule_xxx(tree, src, path) -> List[LintViolation]``
 function and register it in ``_RULES``; tests in ``tests/test_lint.py``
 seed a fixture violating the rule and assert it fires.
@@ -581,6 +591,62 @@ def _rule_unbounded_queue(tree, src, path) -> List[LintViolation]:
     return out
 
 
+# ------------------------------------------------------------------ DLT009
+def _rule_host_work_in_compression(tree, src, path) -> List[LintViolation]:
+    aliases = _import_aliases(tree)
+    out: List[LintViolation] = []
+
+    def in_scope_functions():
+        """(fn, origin) for compression-path functions: name contains
+        'compress', or any method of a class whose name contains
+        'Compression'."""
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and "Compression" in node.name:
+                for meth in ast.walk(node):
+                    if isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        yield meth, f"{node.name}.{meth.name}"
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and "compress" in node.name.lower():
+                yield node, node.name
+
+    def uses_device_math(fn) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Attribute, ast.Name)):
+                q = _resolve(_dotted(node), aliases)
+                if q.startswith(("jax.numpy", "jax.lax")):
+                    return True
+        return False
+
+    seen: Set[int] = set()
+    for fn, origin in in_scope_functions():
+        if id(fn) in seen or not uses_device_math(fn):
+            continue
+        seen.add(id(fn))
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            q = _resolve(_dotted(node.func), aliases)
+            hazard = None
+            if q == "numpy" or q.startswith("numpy."):
+                hazard = f"'{q}(...)' (host numpy)"
+            elif q == "jax.device_get":
+                hazard = "'jax.device_get(...)'"
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item":
+                hazard = "'.item()'"
+            if hazard:
+                out.append(LintViolation(
+                    path, node.lineno, "DLT009",
+                    f"{hazard} inside gradient-compression path "
+                    f"'{origin}' — compress/encode/decode runs inside the "
+                    "traced train step, where host-side work forces a "
+                    "host-device sync every step; keep the pass in jnp on "
+                    "the gradient pytree (or waive inline for a "
+                    "deliberately host-side helper)"))
+    return out
+
+
 # ----------------------------------------------------------------- harness
 _RULES = (
     _rule_module_level_jnp,
@@ -591,6 +657,7 @@ _RULES = (
     _rule_swallowed_storage_error,
     _rule_metric_registration,
     _rule_unbounded_queue,
+    _rule_host_work_in_compression,
 )
 
 

@@ -147,6 +147,8 @@ def shard_snapshot(model) -> dict:
         raise TypeError(f"Cannot checkpoint {type(model)}")
     host = jax.process_index()
     rng = model._rng
+    comp = getattr(model, "grad_compression", None)
+    cs = getattr(model, "compress_state", None)
     return {
         "model_type": model_type,
         "conf_json": model.conf.to_json(),
@@ -159,6 +161,11 @@ def shard_snapshot(model) -> dict:
                          else _tree_blocks(model.opt_state)),
         "rng": (None if (rng is None or host != 0)
                 else np.asarray(jax.random.key_data(rng))),
+        # gradient-compression ride-along: residual/controller blocks shard
+        # exactly like opt_state (replicated residuals land in host 0's
+        # shard once), the scheme config rides the shard metadata
+        "grad_compression": None if comp is None else comp.to_config(),
+        "compressState": None if cs is None else _tree_blocks(cs),
     }
 
 
@@ -194,16 +201,18 @@ def simulated_shard_snapshots(model, num_hosts: int) -> List[dict]:
         return out
 
     base = shard_snapshot(model)
+    cs = getattr(model, "compress_state", None)
     snaps = []
     for host in range(num_hosts):
         snaps.append({
             **{k: base[k] for k in ("model_type", "conf_json", "iteration",
-                                    "epoch")},
+                                    "epoch", "grad_compression")},
             "host": host,
             "num_hosts": num_hosts,
             "coefficients": split([model.params, model.state], host),
             "updaterState": (None if model.opt_state is None
                              else split(model.opt_state, host)),
+            "compressState": None if cs is None else split(cs, host),
             "rng": base["rng"] if host == 0 else None,
         })
     return snaps
@@ -228,12 +237,18 @@ def shard_zip_bytes(snap: dict, extra_meta: Optional[dict] = None) -> bytes:
         "num_hosts": snap["num_hosts"],
         "has_updater": snap["updaterState"] is not None,
         "has_rng": snap["rng"] is not None,
+        "grad_compression": snap.get("grad_compression"),
+        "has_compress": snap.get("compressState") is not None,
     }
     meta.update(extra_meta or {})
     index, arrays = [], {}
-    for tree in ("coefficients", "updaterState"):
-        for i, b in enumerate(snap[tree] or []):
-            key = f"{tree[0]}{i}"
+    # distinct per-tree key prefixes (compressState cannot share
+    # coefficients' "c"); readers resolve keys through blockindex.json, so
+    # old shards stay readable
+    for tree, prefix in (("coefficients", "c"), ("updaterState", "u"),
+                         ("compressState", "x")):
+        for i, b in enumerate(snap.get(tree) or []):
+            key = f"{prefix}{i}"
             index.append({"key": key, "tree": tree, "leaf": b["leaf"],
                           "shape": b["shape"], "dtype": b["dtype"],
                           "index": [list(p) for p in b["index"]]})
@@ -351,6 +366,16 @@ def restore_from_payloads(payloads: List[bytes], load_updater: bool = True):
     if load_updater and meta.get("has_updater"):
         upd = _assemble(parsed, "updaterState")
         model.opt_state = _restore_into(model.opt_state, upd)
+    if meta.get("grad_compression"):
+        # same ride-along policy as the whole-zip restore (shared helper) —
+        # residuals reassembled like opt_state: replicated residuals
+        # restore onto ANY world size
+        from deeplearning4j_tpu.parallel.compress import (
+            restore_compress_state)
+        cs = _assemble(parsed, "compressState") \
+            if meta.get("has_compress") else None
+        restore_compress_state(model, meta["grad_compression"], cs,
+                               origin="sharded")
     if meta_p["rng"] is not None:
         model._rng = jax.random.wrap_key_data(jnp.asarray(meta_p["rng"]))
     model.iteration = int(meta.get("iteration", 0))
@@ -413,13 +438,14 @@ def scan_shard_sets(storage) -> List[dict]:
 
 # ---------------------------------------------------------------- utilities
 def state_sha(model) -> str:
-    """Deterministic digest over params + layer state + opt-state (leaf
-    order, shapes, dtypes and bytes) — the cross-world equality probe the
-    elastic tests use: a checkpoint restored into ANY world size must
-    produce the same digest."""
+    """Deterministic digest over params + layer state + opt-state (+ the
+    gradient-compression residual/controller state when present) — the
+    cross-world equality probe the elastic tests use: a checkpoint
+    restored into ANY world size must produce the same digest."""
     import jax
     h = hashlib.sha256()
-    for tree in (model.params, model.state, model.opt_state):
+    for tree in (model.params, model.state, model.opt_state,
+                 getattr(model, "compress_state", None)):
         for leaf in jax.tree_util.tree_leaves(tree):
             a = np.ascontiguousarray(np.asarray(jax.device_get(leaf)))
             h.update(str((a.shape, str(a.dtype))).encode())

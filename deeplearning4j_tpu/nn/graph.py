@@ -79,6 +79,11 @@ class ComputationGraph:
         # restore_best) and never consumed.
         self._resume_state = None
         self._restored_from = None
+        # compressed gradient collectives (parallel/compress.py) — same
+        # contract as MultiLayerNetwork: scheme config + device-resident
+        # error-feedback state threaded through the jitted step
+        self.grad_compression = None
+        self.compress_state = None
         self._jit_cache = {}
         # per-network compile/dispatch counters (perf/compile_watch.py)
         self.compile_watch = CompileWatch("ComputationGraph")
@@ -286,6 +291,20 @@ class ComputationGraph:
 
     def _make_tbptt_step(self):
         value_and_grad = jax.value_and_grad(self._loss_fn_tbptt, has_aux=True)
+        comp = self.grad_compression
+        if comp is not None:
+            def step_c(params, state, opt_state, cstate, carries, rng,
+                       inputs, labels, fmasks, lmasks):
+                (loss, (new_state, new_carries)), grads = value_and_grad(
+                    params, state, carries, inputs, labels, rng, fmasks,
+                    lmasks)
+                grads, cstate = comp.apply(grads, cstate)
+                new_params, new_opt = self._apply_updates(params, grads,
+                                                          opt_state)
+                return (new_params, new_state, new_opt, cstate, new_carries,
+                        loss)
+
+            return jax.jit(step_c, donate_argnums=(0, 1, 2, 3, 4))
 
         def step(params, state, opt_state, carries, rng, inputs, labels,
                  fmasks, lmasks):
@@ -325,9 +344,19 @@ class ComputationGraph:
             lms = (None if lmasks is None else
                    [None if m is None else m[:, s:e] for m in lmasks])
             self._rng, k = jax.random.split(self._rng)
-            self.params, self.state, self.opt_state, carries, loss = step(
-                self.params, self.state, self.opt_state, carries, k,
-                xs, ys, fms, lms)
+            if self.grad_compression is not None:
+                if self.compress_state is None:
+                    from deeplearning4j_tpu.parallel.compress import (
+                        ensure_compress_state)
+                    ensure_compress_state(self)
+                (self.params, self.state, self.opt_state,
+                 self.compress_state, carries, loss) = step(
+                    self.params, self.state, self.opt_state,
+                    self.compress_state, carries, k, xs, ys, fms, lms)
+            else:
+                self.params, self.state, self.opt_state, carries, loss = step(
+                    self.params, self.state, self.opt_state, carries, k,
+                    xs, ys, fms, lms)
             self._score = loss
             self.last_batch_size = int(inputs[0].shape[0])
             # one optimizer update per window == one iteration (MLN parity)
@@ -410,6 +439,20 @@ class ComputationGraph:
 
     def _make_train_step(self):
         value_and_grad = jax.value_and_grad(self._loss_fn, has_aux=True)
+        comp = self.grad_compression
+        if comp is not None:
+            # compressed collectives (parallel/compress.py): encode→decode
+            # + error-feedback residual update inside the compiled step
+            def step_c(params, state, opt_state, cstate, rng, inputs,
+                       labels, fmasks, lmasks):
+                (loss, new_state), grads = value_and_grad(
+                    params, state, inputs, labels, rng, fmasks, lmasks)
+                grads, cstate = comp.apply(grads, cstate)
+                new_params, new_opt = self._apply_updates(params, grads,
+                                                          opt_state)
+                return new_params, new_state, new_opt, cstate, loss
+
+            return jax.jit(step_c, donate_argnums=(0, 1, 2, 3))
 
         def step(params, state, opt_state, rng, inputs, labels, fmasks, lmasks):
             (loss, new_state), grads = value_and_grad(
@@ -420,7 +463,10 @@ class ComputationGraph:
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
     def _get_jitted(self, kind):
-        fn = self._jit_cache.get(kind)
+        # the compression scheme is part of the cache key (see
+        # multilayer.py): enabling grad_compression mints a fresh step
+        key = (kind, self.grad_compression)
+        fn = self._jit_cache.get(key)
         if fn is None:
             if kind == "train":
                 fn = self._make_train_step()
@@ -446,7 +492,7 @@ class ComputationGraph:
             else:
                 raise KeyError(kind)
             fn = self.compile_watch.wrap(fn, kind)
-            self._jit_cache[kind] = fn
+            self._jit_cache[key] = fn
         return fn
 
     # ------------------------------------------------------------------- fit
@@ -538,8 +584,18 @@ class ComputationGraph:
                 self._fit_tbptt(inputs, labels, fmasks, lmasks)
                 return
         self._rng, k = jax.random.split(self._rng)
-        self.params, self.state, self.opt_state, loss = step(
-            self.params, self.state, self.opt_state, k, inputs, labels, fmasks, lmasks)
+        if self.grad_compression is not None:
+            if self.compress_state is None:
+                from deeplearning4j_tpu.parallel.compress import (
+                    ensure_compress_state)
+                ensure_compress_state(self)
+            (self.params, self.state, self.opt_state, self.compress_state,
+             loss) = step(self.params, self.state, self.opt_state,
+                          self.compress_state, k, inputs, labels, fmasks,
+                          lmasks)
+        else:
+            self.params, self.state, self.opt_state, loss = step(
+                self.params, self.state, self.opt_state, k, inputs, labels, fmasks, lmasks)
         self._score = loss
         self.last_batch_size = int(inputs[0].shape[0])
         # first sample per input only (see multilayer.py note)

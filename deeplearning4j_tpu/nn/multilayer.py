@@ -28,7 +28,8 @@ from deeplearning4j_tpu.nn.conf.layers import (apply_constraints, apply_layer,
                                                dropout_input, noisy_params)
 from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
 from deeplearning4j_tpu.optimize.fused_update import bucketed_apply
-from deeplearning4j_tpu.optimize.updaters import gradient_normalization
+from deeplearning4j_tpu.optimize.updaters import (gradient_normalization,
+                                                  is_sgd_family)
 from deeplearning4j_tpu.perf.compile_watch import CompileWatch
 import optax
 
@@ -93,6 +94,13 @@ class MultiLayerNetwork:
         # restore_best) and never consumed.
         self._resume_state = None
         self._restored_from = None
+        # compressed gradient collectives (parallel/compress.py): the
+        # scheme config plus device-resident error-feedback state threaded
+        # through the jitted step next to opt_state. Set via
+        # enable_grad_compression / ParallelWrapper(grad_compression=);
+        # restored from checkpoint metadata by utils/serialization.
+        self.grad_compression = None
+        self.compress_state = None
 
     # ------------------------------------------------------------------ init
     def init(self, seed: Optional[int] = None,
@@ -257,6 +265,22 @@ class MultiLayerNetwork:
 
     def _make_train_step(self):
         value_and_grad = jax.value_and_grad(self._loss_fn, has_aux=True)
+        comp = self.grad_compression
+        if comp is not None:
+            # compressed collectives (parallel/compress.py): the encode→
+            # decode + error-feedback residual update runs INSIDE the
+            # compiled step on the gradient pytree; cstate is donated
+            # alongside opt_state
+            def step_c(params, state, opt_state, cstate, rng, x, y, fmask,
+                       lmask):
+                (loss, new_state), grads = value_and_grad(
+                    params, state, x, y, rng, fmask, lmask)
+                grads, cstate = comp.apply(grads, cstate)
+                new_params, new_opt = self._apply_updates(params, grads,
+                                                          opt_state)
+                return new_params, new_state, new_opt, cstate, loss
+
+            return jax.jit(step_c, donate_argnums=(0, 1, 2, 3))
 
         def step(params, state, opt_state, rng, x, y, fmask, lmask):
             (loss, new_state), grads = value_and_grad(params, state, x, y, rng, fmask, lmask)
@@ -330,13 +354,17 @@ class MultiLayerNetwork:
         # a restored model's resume marker is only meaningful to fit()'s
         # batch loop; consume it so it can't mis-skip a LATER fit call
         self._resume_state = None
-        if self.conf.optimization_algo not in ("sgd",
-                                               "stochastic_gradient_descent"):
+        if not is_sgd_family(self.conf):
             raise ValueError("fit_fused supports the jitted SGD-family path "
                              "only; use fit() for solver-based optimization")
         if self.conf.backprop_type == "tbptt":
             raise ValueError("fit_fused does not window tBPTT sequences; "
                              "use fit() for tbptt-configured networks")
+        if self.grad_compression is not None:
+            raise ValueError(
+                "fit_fused does not support grad_compression: the "
+                "compressed collective is wired into the per-batch jitted "
+                "step — train through fit() (or ParallelWrapper.fit)")
         fmasks = lmasks = None
         if isinstance(datasets, tuple) and len(datasets) == 2:
             xa, ya = datasets
@@ -421,6 +449,19 @@ class MultiLayerNetwork:
         traced program, so gradients truncate at the window boundary exactly
         like the reference's stored-state scheme."""
         value_and_grad = jax.value_and_grad(self._loss_fn_tbptt, has_aux=True)
+        comp = self.grad_compression
+        if comp is not None:
+            def step_c(params, state, opt_state, cstate, carries, rng, x, y,
+                       fmask, lmask):
+                (loss, (new_state, new_carries)), grads = value_and_grad(
+                    params, state, carries, x, y, rng, fmask, lmask)
+                grads, cstate = comp.apply(grads, cstate)
+                new_params, new_opt = self._apply_updates(params, grads,
+                                                          opt_state)
+                return (new_params, new_state, new_opt, cstate, new_carries,
+                        loss)
+
+            return jax.jit(step_c, donate_argnums=(0, 1, 2, 3, 4))
 
         def step(params, state, opt_state, carries, rng, x, y, fmask, lmask):
             (loss, (new_state, new_carries)), grads = value_and_grad(
@@ -474,7 +515,10 @@ class MultiLayerNetwork:
         return self._rnn_carries
 
     def _get_jitted(self, kind, key=()):
-        k = (kind,) + tuple(key)
+        # the compression scheme is part of the cache key: enabling (or
+        # changing) grad_compression mints a fresh compressed step instead
+        # of reusing the uncompressed program under the same name
+        k = (kind, self.grad_compression) + tuple(key)
         fn = self._jit_cache.get(k)
         if fn is None:
             if kind == "train":
@@ -620,8 +664,7 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.checkpoint.manager import (
             resume_plan, skip_consumed_batches)
         epochs_to_run, skip = resume_plan(self, num_epochs)
-        if self.conf.optimization_algo not in (
-                "sgd", "stochastic_gradient_descent"):
+        if not is_sgd_family(self.conf):
             # full-batch solver path (reference Solver.java dispatch on
             # OptimizationAlgorithm — LBFGS / CG / line gradient descent)
             if bucket_policy is not None or prefetch:
@@ -723,8 +766,17 @@ class MultiLayerNetwork:
             self._fit_tbptt(x, y, fm, lm)
             return
         self._rng, k = jax.random.split(self._rng)
-        self.params, self.state, self.opt_state, loss = train_step(
-            self.params, self.state, self.opt_state, k, x, y, fm, lm)
+        if self.grad_compression is not None:
+            if self.compress_state is None:
+                from deeplearning4j_tpu.parallel.compress import (
+                    ensure_compress_state)
+                ensure_compress_state(self)
+            (self.params, self.state, self.opt_state, self.compress_state,
+             loss) = train_step(self.params, self.state, self.opt_state,
+                                self.compress_state, k, x, y, fm, lm)
+        else:
+            self.params, self.state, self.opt_state, loss = train_step(
+                self.params, self.state, self.opt_state, k, x, y, fm, lm)
         self._score = loss
         self.last_batch_size = int(x.shape[0])
         # first sample only: listeners sample activations, and pinning
@@ -773,6 +825,11 @@ class MultiLayerNetwork:
         if self.conf.backprop_type != "tbptt":
             raise ValueError("fit_tbptt_fused requires backprop_type='tbptt' "
                              "(this network is 'standard'; use fit/fit_fused)")
+        if self.grad_compression is not None:
+            raise ValueError(
+                "fit_tbptt_fused does not support grad_compression: the "
+                "compressed collective is wired into the per-window jitted "
+                "step — train through fit()")
         x = jnp.asarray(x)
         y = jnp.asarray(y)
         L = self.conf.tbptt_fwd_length
@@ -817,8 +874,18 @@ class MultiLayerNetwork:
             fs = None if fm is None else fm[:, s:e]
             ls = None if lm is None else lm[:, s:e]
             self._rng, k = jax.random.split(self._rng)
-            self.params, self.state, self.opt_state, carries, loss = step(
-                self.params, self.state, self.opt_state, carries, k, xs, ys, fs, ls)
+            if self.grad_compression is not None:
+                if self.compress_state is None:
+                    from deeplearning4j_tpu.parallel.compress import (
+                        ensure_compress_state)
+                    ensure_compress_state(self)
+                (self.params, self.state, self.opt_state,
+                 self.compress_state, carries, loss) = step(
+                    self.params, self.state, self.opt_state,
+                    self.compress_state, carries, k, xs, ys, fs, ls)
+            else:
+                self.params, self.state, self.opt_state, carries, loss = step(
+                    self.params, self.state, self.opt_state, carries, k, xs, ys, fs, ls)
             self._score = loss
             self.last_batch_size = int(x.shape[0])
             self._last_features = xs[:1]
@@ -884,4 +951,8 @@ class MultiLayerNetwork:
             other.state = jax.tree_util.tree_map(jnp.array, self.state)
             other.opt_state = jax.tree_util.tree_map(jnp.array, self.opt_state)
             other._rng = self._rng
+        other.grad_compression = self.grad_compression
+        if self.compress_state is not None:
+            other.compress_state = jax.tree_util.tree_map(
+                jnp.array, self.compress_state)
         return other

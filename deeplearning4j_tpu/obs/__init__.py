@@ -30,7 +30,7 @@ from deeplearning4j_tpu.obs.registry import (  # noqa: F401
     Counter, Gauge, Histogram, MetricError, MetricsRegistry,
     absorb_checkpoint_manager, absorb_compile_watch, absorb_inference_stats,
     absorb_model_server, absorb_training_stats, get_registry,
-    publish_stats_update, watch_training_stats)
+    publish_stats_update, watch_grad_compression, watch_training_stats)
 from deeplearning4j_tpu.obs.trace import (  # noqa: F401
     Stopwatch, Tracer, configure_tracer, get_tracer)
 from deeplearning4j_tpu.obs.flight import (  # noqa: F401
@@ -42,7 +42,7 @@ from deeplearning4j_tpu.obs.exporters import (  # noqa: F401
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricError", "MetricsRegistry",
     "get_registry", "absorb_compile_watch", "absorb_training_stats",
-    "watch_training_stats",
+    "watch_training_stats", "watch_grad_compression",
     "absorb_inference_stats", "absorb_checkpoint_manager",
     "publish_stats_update",
     "Tracer", "get_tracer", "configure_tracer", "Stopwatch",

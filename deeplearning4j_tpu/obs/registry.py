@@ -40,7 +40,7 @@ __all__ = [
     "get_registry", "absorb_compile_watch", "absorb_training_stats",
     "watch_training_stats",
     "absorb_inference_stats", "absorb_checkpoint_manager",
-    "absorb_model_server",
+    "absorb_model_server", "watch_grad_compression",
     "publish_stats_update", "DEFAULT_BUCKETS_MS",
 ]
 
@@ -483,6 +483,94 @@ def absorb_model_server(registry: MetricsRegistry, server):
                   help="cumulative breaker open transitions across all "
                        "endpoints").set(sum(b.opens for b in breakers))
 
+    registry.register_callback(_cb)
+    return _cb
+
+
+def watch_grad_compression(registry: MetricsRegistry, model):
+    """Register a collect-time callback pulling a compressed model's
+    device-resident accounting state (parallel/compress.py) into the
+    registry: compression ratio + residual-norm gauges and cumulative
+    dense/wire bytes-on-wire counters. The device scalars are fetched at
+    SCRAPE time only — never on the step path, which stays sync-free.
+    Weakref'd + self-removing like the other absorbers; counter deltas are
+    tracked per callback so the process-wide counters count only bytes
+    accumulated while THIS callback watched — ``_cb.reseed()`` (called by
+    the checkpoint restore path) re-baselines the delta tracking at the
+    restored accumulator values so a kill-and-resume never re-counts the
+    pre-crash history."""
+    ref = weakref.ref(model)
+    seen = {"dense": 0.0, "wire": 0.0}
+
+    def _read(st):
+        """Fetch every device scalar into plain floats BEFORE touching any
+        instrument, so a scrape never exports a torn read."""
+        import numpy as _np
+        acc = {k: float(_np.asarray(v)) for k, v in st["acc"].items()}
+        ctrl = st.get("ctrl") or {}
+        tau = float(_np.asarray(ctrl["tau"])) if "tau" in ctrl else None
+        return acc, tau
+
+    def _cb(reg: MetricsRegistry):
+        live = ref()
+        if live is None:
+            reg.unregister_callback(_cb)
+            return
+        # the jitted step DONATES the state buffers it consumes; a scrape
+        # racing a step can catch the old tree mid-deletion — re-read the
+        # fresh attribute, and skip this scrape under a sustained storm
+        for _ in range(3):
+            st = getattr(live, "compress_state", None)
+            if st is None:
+                return
+            try:
+                acc, tau = _read(st)
+                break
+            except RuntimeError:
+                continue
+        else:
+            return
+        reg.gauge("grad_compress_ratio", unit="x",
+                  help="dense/compressed bytes-on-wire ratio of the last "
+                       "compressed training step").set(acc["last_ratio"])
+        reg.gauge("grad_compress_steps", unit="steps",
+                  help="training steps that ran the compressed gradient "
+                       "collective").set(acc["steps"])
+        reg.gauge("grad_residual_norm", unit="l2",
+                  help="global L2 norm of the error-feedback residual "
+                       "after the last compressed step"
+                  ).set(acc["residual_norm"])
+        if tau is not None:
+            reg.gauge("grad_compress_threshold", unit="magnitude",
+                      help="current adaptive threshold tau of the "
+                           "ThresholdCompression controller").set(tau)
+        dense_c = reg.counter(
+            "grad_compress_bytes_dense_total", unit="bytes",
+            help="cumulative bytes a DENSE f32 gradient all-reduce would "
+                 "have moved per participant")
+        wire_c = reg.counter(
+            "grad_compress_bytes_wire_total", unit="bytes",
+            help="cumulative estimated bytes-on-wire of the compressed "
+                 "gradient representation per participant")
+        dense_c.inc(max(0.0, acc["dense_bytes"] - seen["dense"]))
+        wire_c.inc(max(0.0, acc["wire_bytes"] - seen["wire"]))
+        seen["dense"] = max(seen["dense"], acc["dense_bytes"])
+        seen["wire"] = max(seen["wire"], acc["wire_bytes"])
+
+    def _reseed():
+        live = ref()
+        st = getattr(live, "compress_state", None) if live is not None \
+            else None
+        if st is None:
+            return
+        try:
+            acc, _ = _read(st)
+        except RuntimeError:
+            return
+        seen["dense"] = acc["dense_bytes"]
+        seen["wire"] = acc["wire_bytes"]
+
+    _cb.reseed = _reseed
     registry.register_callback(_cb)
     return _cb
 

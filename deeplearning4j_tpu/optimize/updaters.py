@@ -189,6 +189,38 @@ class NoOp(Updater):
         return optax.set_to_zero()
 
 
+_SGD_FAMILY = ("sgd", "stochastic_gradient_descent")
+
+
+def normalize_optimization_algo(name) -> str:
+    """Canonical lowercase-underscore form of an optimization-algorithm
+    name ("Stochastic Gradient Descent" / "SGD" / "sgd" all normalize the
+    same way). The ONE place algo-name spelling is interpreted — dispatch
+    sites compare normalized forms instead of re-hardcoding string
+    variants."""
+    return (str(name or "stochastic_gradient_descent").strip().lower()
+            .replace("-", "_").replace(" ", "_"))
+
+
+def is_sgd_family(algo_or_conf) -> bool:
+    """Whether a config (or raw algo name) trains through the jitted
+    minibatch-SGD step rather than a host-side solver (lbfgs/cg/line
+    descent). Shared by the ParallelWrapper averaging dispatch, the fit()
+    solver dispatch and the gradient-compression guards
+    (parallel/compress.py), replacing per-site lowercase string tuples."""
+    algo = getattr(algo_or_conf, "optimization_algo", algo_or_conf)
+    return normalize_optimization_algo(algo) in _SGD_FAMILY
+
+
+def updater_has_accumulating_state(updater) -> bool:
+    """Whether an updater carries state that integrates gradients over
+    steps (momentum buffers, second-moment accumulators). Such state
+    composes with lossy gradient compression ONLY via error feedback —
+    without it the biased per-step compression error compounds inside the
+    updater state (the guard in parallel/compress.py)."""
+    return not isinstance(updater, (Sgd, NoOp))
+
+
 def gradient_normalization(kind: Optional[str], threshold: float = 1.0):
     """Per-layer gradient normalization (reference GradientNormalization enum,
     applied in BaseMultiLayerUpdater.preApply).

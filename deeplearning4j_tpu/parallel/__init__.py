@@ -31,6 +31,17 @@ from deeplearning4j_tpu.parallel.elastic import (  # noqa: F401
     RendezvousTimeout,
     StaleGenerationError,
 )
+from deeplearning4j_tpu.parallel.compress import (  # noqa: F401
+    GradientCompression,
+    Int8Compression,
+    OneBitCompression,
+    ThresholdCompression,
+    TopKCompression,
+    compression_stats,
+    enable_grad_compression,
+    ensure_compress_state,
+    measure_compression_overhead,
+)
 from deeplearning4j_tpu.parallel.stats import TrainingStats  # noqa: F401
 from deeplearning4j_tpu.parallel.watchdog import (  # noqa: F401
     CollectiveTimeoutError, CollectiveWatchdog,

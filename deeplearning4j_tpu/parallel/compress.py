@@ -1,0 +1,478 @@
+"""Compressed gradient collectives: threshold/top-k/quantized encoding with
+error feedback.
+
+Parity surface: the reference's distinctive scale story — lossy
+threshold-encoded gradient sharing over the Aeron parameter server
+(``EncodedGradientsAccumulator``/``EncodingHandler`` over ND4J
+``ThresholdCompression``, SURVEY §2.4 DP-2/DP-4) — plus the literature it
+descends from: 1-bit SGD with error feedback (Seide et al., 2014) and Deep
+Gradient Compression's top-k sparsification with residual accumulation
+(Lin et al., 2018).
+
+TPU-native placement. On a single slice the gradient all-reduce rides ICI
+and compression is pure overhead — which is why the psum-based
+ClusterTrainer deliberately dropped DP-2 (parallel/trainer.py module
+docstring). Across slices the same collective crosses DCN, where the
+reference's lossy encoding is exactly the right trade again. The schemes
+here run INSIDE the compiled train step, on the gradient pytree, with no
+host syncs:
+
+- the whole transform is ``decode(encode(g + residual))`` followed by the
+  error-feedback residual update ``residual' = (g + residual) - decoded``,
+  carried as extra optimizer-adjacent state threaded through the jitted
+  step (and through checkpoints — see utils/serialization.py and
+  checkpoint/sharded.py);
+- for the dense quantized schemes (:class:`Int8Compression`,
+  :class:`OneBitCompression`) the quantize→psum→dequantize order is what a
+  cross-slice deployment runs (psum of the int representation + scales);
+  under GSPMD the psum XLA inserts during backprop is dense, so this
+  container validates the MATH (quantize→dequantize around the reduced
+  gradient) and accounts the bytes a quantized wire format would move;
+- for the sparse schemes (:class:`ThresholdCompression`,
+  :class:`TopKCompression`) the ICI-resident form is encode→psum of the
+  dense DECODED tensor (sparse representations don't psum), with
+  bytes-on-wire accounting — the estimate that makes the DCN win
+  measurable — tracked per step in the carried state.
+
+Every scheme accumulates, on device (no host syncs; read at scrape time by
+``obs.watch_grad_compression``): cumulative dense vs wire bytes, the last
+step's compression ratio, and the residual's global L2 norm.
+
+Enable via ``ParallelWrapper(net, grad_compression=ThresholdCompression())``
+/ ``ClusterTrainer(...)``, or directly with
+:func:`enable_grad_compression` for single-device training. The scheme
+config rides checkpoint metadata, so ``restore_latest`` rebuilds the
+compressed step and restores the residuals — kill-and-resume is bitwise
+identical to the uninterrupted compressed run, and an elastic N→M
+membership change restores residuals like any other replicated state (or
+deterministically resets them to zeros when the checkpoint predates
+compression).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "GradientCompression", "ThresholdCompression", "TopKCompression",
+    "Int8Compression", "OneBitCompression", "enable_grad_compression",
+    "ensure_compress_state", "measure_compression_overhead",
+    "compression_stats",
+]
+
+_SCHEME_REGISTRY = {}
+
+# fixed per-leaf framing overhead of the accounted wire formats (shape/
+# length/scale header — DL4J's threshold encoding carries a 4-int header)
+_HEADER_BYTES = 16.0
+
+_ACC_KEYS = ("steps", "dense_bytes", "wire_bytes", "last_wire_bytes",
+             "last_ratio", "residual_norm")
+
+
+def register_scheme(cls):
+    _SCHEME_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@dataclasses.dataclass(frozen=True)
+class GradientCompression:
+    """Base config: shared error-feedback + accounting machinery; schemes
+    implement ``_encode_decode`` (one leaf) and optionally ``_init_ctrl`` /
+    ``_update_ctrl`` (controller state, e.g. the adaptive threshold).
+
+    ``error_feedback=True`` (default) carries the per-parameter residual
+    ``r' = (g + r) - decode(encode(g + r))`` so the lossy update stays
+    unbiased over time — the property that makes compression compose with
+    momentum/accumulator updaters at all. Disabling it is only legal with
+    stateless updaters (guarded by :func:`enable_grad_compression`)."""
+
+    error_feedback: bool = True
+
+    # ------------------------------------------------------------- config
+    def to_config(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["@scheme"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_config(d: dict) -> "GradientCompression":
+        d = dict(d)
+        name = d.pop("@scheme")
+        cls = _SCHEME_REGISTRY.get(name)
+        if cls is None:
+            raise ValueError(f"unknown gradient-compression scheme {name!r} "
+                             f"(known: {sorted(_SCHEME_REGISTRY)})")
+        return cls(**d)
+
+    # -------------------------------------------------------------- state
+    def _init_ctrl(self) -> dict:
+        return {}
+
+    def _update_ctrl(self, ctrl: dict, nnz_total, n_total: int) -> dict:
+        return ctrl
+
+    def init_state(self, params) -> dict:
+        """Device-resident compression state: the error-feedback residual
+        (zeros, f32, param shapes), the controller state, and the
+        bytes-on-wire accumulators. Lives next to ``opt_state`` on the
+        model and is donated through the jitted step like it."""
+        residual = None
+        if self.error_feedback:
+            residual = jax.tree_util.tree_map(
+                lambda a: jnp.zeros(a.shape, jnp.float32), params)
+        return {
+            "residual": residual,
+            "ctrl": self._init_ctrl(),
+            "acc": {k: jnp.zeros((), jnp.float32) for k in _ACC_KEYS},
+        }
+
+    # ----------------------------------------------------------- encoding
+    def _encode_decode(self, v, ctrl):
+        """One f32 leaf -> (decoded leaf, wire_bytes scalar, nnz scalar).
+        Pure jnp — this runs inside the traced train step (lint DLT009
+        flags host-side work here)."""
+        raise NotImplementedError
+
+    def apply(self, grads, state):
+        """The in-step transform: error-feedback encode/decode over the
+        gradient pytree. Returns ``(decoded_grads, new_state)``; traced
+        into the train step, zero host syncs (trace_check-asserted in
+        tests/test_compress.py)."""
+        ctrl = state["ctrl"]
+        acc = state["acc"]
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        if state["residual"] is not None:
+            res_leaves = jax.tree_util.tree_flatten(state["residual"])[0]
+        else:
+            res_leaves = [None] * len(leaves)
+        dec_leaves, new_res = [], []
+        wire_total = jnp.zeros((), jnp.float32)
+        nnz_total = jnp.zeros((), jnp.float32)
+        n_total = 0
+        dense_total = 0.0  # static: byte count of the uncompressed tree
+        for g, r in zip(leaves, res_leaves):
+            v = g.astype(jnp.float32)
+            if r is not None:
+                v = v + r
+            dec, wire, nnz = self._encode_decode(v, ctrl)
+            wire_total = wire_total + wire
+            nnz_total = nnz_total + nnz
+            n_total += v.size
+            dense_total += float(v.size * 4)  # f32 gradient on the wire
+            if r is not None:
+                new_res.append(v - dec)
+            dec_leaves.append(dec.astype(g.dtype))
+        new_ctrl = self._update_ctrl(ctrl, nnz_total, max(n_total, 1))
+        residual = None
+        rnorm = jnp.zeros((), jnp.float32)
+        if state["residual"] is not None:
+            residual = jax.tree_util.tree_unflatten(treedef, new_res)
+            sq = jnp.zeros((), jnp.float32)
+            for r in new_res:
+                sq = sq + jnp.sum(r * r)
+            rnorm = jnp.sqrt(sq)
+        new_acc = {
+            "steps": acc["steps"] + 1.0,
+            "dense_bytes": acc["dense_bytes"] + dense_total,
+            "wire_bytes": acc["wire_bytes"] + wire_total,
+            "last_wire_bytes": wire_total,
+            "last_ratio": dense_total / jnp.maximum(wire_total, 1.0),
+            "residual_norm": rnorm,
+        }
+        decoded = jax.tree_util.tree_unflatten(treedef, dec_leaves)
+        return decoded, {"residual": residual, "ctrl": new_ctrl,
+                         "acc": new_acc}
+
+
+@register_scheme
+@dataclasses.dataclass(frozen=True)
+class ThresholdCompression(GradientCompression):
+    """DL4J's scheme: encode ``|v| >= tau`` as ``sign(v) * tau``, drop the
+    rest into the residual. The adaptive controller mirrors DL4J's
+    ``AdaptiveThresholdAlgorithm``: after each step the GLOBAL encoded
+    fraction is compared to ``target_sparsity`` and ``tau`` is nudged by
+    ``adjust_rate`` (within a deadband and hard bounds), carried as
+    device-side controller state.
+
+    Wire accounting follows DL4J's dual encoding: 4-byte signed index per
+    encoded element (sparse form) OR 2 bits/element (bitmap form),
+    whichever is smaller, plus a fixed header per tensor."""
+
+    threshold: float = 1e-3
+    adaptive: bool = True
+    target_sparsity: float = 1e-3
+    adjust_rate: float = 1.2
+    deadband: float = 2.0
+    min_threshold: float = 1e-6
+    max_threshold: float = 1.0
+
+    def _init_ctrl(self) -> dict:
+        return {"tau": jnp.full((), float(self.threshold), jnp.float32)}
+
+    def _update_ctrl(self, ctrl, nnz_total, n_total):
+        if not self.adaptive:
+            return ctrl
+        tau = ctrl["tau"]
+        ratio = nnz_total / float(n_total)
+        hi = self.target_sparsity * self.deadband
+        lo = self.target_sparsity / self.deadband
+        tau = jnp.where(ratio > hi, tau * self.adjust_rate,
+                        jnp.where(ratio < lo, tau / self.adjust_rate, tau))
+        return {"tau": jnp.clip(tau, self.min_threshold, self.max_threshold)}
+
+    def _encode_decode(self, v, ctrl):
+        tau = ctrl["tau"]
+        mask = jnp.abs(v) >= tau
+        dec = jnp.where(mask, jnp.sign(v) * tau, 0.0)
+        nnz = jnp.sum(mask.astype(jnp.float32))
+        sparse_bytes = 4.0 * nnz + _HEADER_BYTES
+        bitmap_bytes = math.ceil(v.size / 16) * 4.0 + _HEADER_BYTES
+        return dec, jnp.minimum(sparse_bytes, bitmap_bytes), nnz
+
+
+@register_scheme
+@dataclasses.dataclass(frozen=True)
+class TopKCompression(GradientCompression):
+    """Deep Gradient Compression-style per-tensor top-k by magnitude: the
+    ``ratio`` fraction of largest-|v| entries pass through with their
+    VALUES (not clamped), the rest accumulate in the residual. Ties at the
+    k-th magnitude all pass (deterministic; never fewer than k). Wire
+    accounting: 4-byte index + 4-byte value per kept element + header."""
+
+    ratio: float = 0.01
+    min_k: int = 1
+
+    def _encode_decode(self, v, ctrl):
+        flat = v.reshape(-1)
+        n = flat.size
+        k = min(n, max(int(self.min_k), int(round(self.ratio * n))))
+        a = jnp.abs(flat)
+        kth = jax.lax.top_k(a, k)[0][k - 1]
+        # a zero k-th magnitude must not pass the whole (zero) tensor
+        mask = (a >= kth) & (a > 0)
+        dec = jnp.where(mask, flat, 0.0).reshape(v.shape)
+        nnz = jnp.sum(mask.astype(jnp.float32))
+        return dec, 8.0 * nnz + _HEADER_BYTES, nnz
+
+
+@register_scheme
+@dataclasses.dataclass(frozen=True)
+class Int8Compression(GradientCompression):
+    """Scaled int8 quantization: symmetric round-to-nearest onto
+    [-127, 127] with a max-abs scale per tensor (default) or per
+    ``chunk_size`` slice. The int8 lattice is closed under addition up to
+    world-size headroom, so a cross-slice deployment psums the int
+    representation + scales (dense-quantized psum); here the math is
+    validated as quantize→dequantize around the reduced gradient. Wire:
+    1 byte/element + 4 bytes/scale + header."""
+
+    chunk_size: Optional[int] = None
+
+    def _encode_decode(self, v, ctrl):
+        flat = v.reshape(-1)
+        n = flat.size
+        if self.chunk_size and n > int(self.chunk_size):
+            c = int(self.chunk_size)
+            pad = (-n) % c
+            m = jnp.pad(flat, (0, pad)).reshape(-1, c)
+            scale = jnp.maximum(
+                jnp.max(jnp.abs(m), axis=1, keepdims=True) / 127.0, 1e-30)
+            q = jnp.clip(jnp.round(m / scale), -127.0, 127.0)
+            dec = (q * scale).reshape(-1)[:n].reshape(v.shape)
+            nnz = jnp.sum((q != 0).astype(jnp.float32))
+            n_scales = m.shape[0]
+        else:
+            scale = jnp.maximum(jnp.max(jnp.abs(flat)) / 127.0, 1e-30)
+            q = jnp.clip(jnp.round(flat / scale), -127.0, 127.0)
+            dec = (q * scale).reshape(v.shape)
+            nnz = jnp.sum((q != 0).astype(jnp.float32))
+            n_scales = 1
+        return dec, float(n) + 4.0 * n_scales + _HEADER_BYTES, nnz
+
+
+@register_scheme
+@dataclasses.dataclass(frozen=True)
+class OneBitCompression(GradientCompression):
+    """1-bit SGD (Seide et al., 2014): per tensor, each element is reduced
+    to its sign bit and decoded as the mean of its sign class (two f32
+    scales per tensor) — error feedback carries everything the sign bit
+    drops. Wire: 1 bit/element + 2 scales + header."""
+
+    def _encode_decode(self, v, ctrl):
+        flat = v.reshape(-1)
+        n = flat.size
+        posf = (flat >= 0).astype(jnp.float32)
+        cnt_p = jnp.sum(posf)
+        mean_p = jnp.sum(flat * posf) / jnp.maximum(cnt_p, 1.0)
+        mean_n = jnp.sum(flat * (1.0 - posf)) / jnp.maximum(n - cnt_p, 1.0)
+        dec = jnp.where(flat >= 0, mean_p, mean_n).reshape(v.shape)
+        wire = math.ceil(n / 8) + 8.0 + _HEADER_BYTES
+        return dec, jnp.full((), wire, jnp.float32), jnp.full((), float(n),
+                                                             jnp.float32)
+
+
+# ------------------------------------------------------------------ wiring
+def _model_updaters(model):
+    ups = getattr(model, "_updaters", None)
+    if ups is None:
+        return []
+    return list(ups.values()) if isinstance(ups, dict) else list(ups)
+
+
+def enable_grad_compression(model, scheme: Optional[GradientCompression]):
+    """Attach ``scheme`` to ``model`` (MultiLayerNetwork/ComputationGraph):
+    the next minted train/tbptt step compresses gradients in-step. Guards:
+
+    - only the jitted SGD-family path compiles compression in — solver
+      configs (lbfgs/cg/line descent) raise here, before any trace;
+    - ``error_feedback=False`` composes only with stateless updaters: a
+      momentum/accumulator updater (Nesterovs/Adam/RmsProp/...) would
+      integrate the biased compression error into its state every step and
+      drift — raise with the fix spelled out;
+    - a model already compressed with a DIFFERENT config raises (the
+      carried state belongs to the old scheme).
+
+    Also registers the obs collect-time absorber so ``/metrics`` carries
+    the compression ratio / bytes-on-wire / residual-norm instruments."""
+    if scheme is None:
+        return model
+    existing = getattr(model, "grad_compression", None)
+    if existing is not None:
+        if existing != scheme:
+            raise ValueError(
+                f"model already has grad_compression={existing!r}; refusing "
+                f"to switch to {scheme!r} mid-run — the carried residual/"
+                "controller state belongs to the old scheme (reset "
+                "model.grad_compression and model.compress_state to None "
+                "first if the switch is intentional)")
+        return model
+    from deeplearning4j_tpu.optimize.updaters import (
+        is_sgd_family, updater_has_accumulating_state)
+    algo = getattr(model.conf, "optimization_algo",
+                   "stochastic_gradient_descent")
+    if not is_sgd_family(algo):
+        raise ValueError(
+            f"grad_compression requires the jitted SGD-family training "
+            f"path; this network is configured with optimization_algo="
+            f"{algo!r} (solver path) — compression cannot be compiled into "
+            "a host-side solver loop")
+    if not scheme.error_feedback:
+        bad = sorted({type(u).__name__ for u in _model_updaters(model)
+                      if updater_has_accumulating_state(u)})
+        if bad:
+            raise ValueError(
+                f"grad_compression(error_feedback=False) does not compose "
+                f"with momentum/accumulator updaters ({', '.join(bad)}): "
+                "their state would integrate the biased compression error "
+                "every step and drift from the dense trajectory. Keep "
+                "error_feedback=True (the default) or switch those layers "
+                "to plain Sgd")
+    model.grad_compression = scheme
+    from deeplearning4j_tpu.obs.registry import (get_registry,
+                                                 watch_grad_compression)
+    model._grad_compress_watch = watch_grad_compression(get_registry(), model)
+    return model
+
+
+def restore_compress_state(model, scheme_config, arrays=None,
+                           origin="checkpointed"):
+    """The checkpoint ride-along restore policy, shared by the whole-zip
+    (utils/serialization.py) and sharded (checkpoint/sharded.py) paths:
+    rebuild the scheme from its checkpoint config, enable it on the model,
+    and restore ``arrays`` (a flat name->ndarray mapping of the state tree)
+    into the zeros template so the next ``fit`` re-mints the compressed
+    step and continues the residual chain bitwise. A state that no longer
+    fits the template (scheme config drift) — or ``arrays=None`` (a
+    checkpoint saved before the first compressed step) — resets
+    DETERMINISTICALLY to zeros, the documented fallback policy. Also
+    re-baselines the obs bytes-on-wire counter deltas at the restored
+    accumulator values so a kill-and-resume never re-counts the pre-crash
+    history."""
+    import logging
+    from deeplearning4j_tpu.utils.serialization import _restore_into
+    scheme = GradientCompression.from_config(scheme_config)
+    enable_grad_compression(model, scheme)
+    template = scheme.init_state(model.params)
+    model.compress_state = template
+    if arrays:
+        try:
+            model.compress_state = _restore_into(template, arrays)
+        except ValueError as e:
+            logging.getLogger(__name__).warning(
+                "%s compression state does not fit the scheme's template "
+                "(%s) — resetting residuals deterministically to zeros",
+                origin, e)
+    watch = getattr(model, "_grad_compress_watch", None)
+    if watch is not None:
+        watch.reseed()
+    return scheme
+
+
+def ensure_compress_state(model):
+    """Initialize ``model.compress_state`` (zeros residual + controller)
+    when compression is enabled and no state exists yet — a restored model
+    arrives with its state already rebuilt by the checkpoint layer."""
+    scheme = getattr(model, "grad_compression", None)
+    if scheme is None:
+        return None
+    if model.params is None:
+        model.init()
+    if getattr(model, "compress_state", None) is None:
+        model.compress_state = scheme.init_state(model.params)
+    return model.compress_state
+
+
+def compression_stats(model) -> Optional[dict]:
+    """Host-side read of the device-resident accounting accumulators —
+    call OFF the step path (this syncs). Returns None when the model has
+    no compression state yet."""
+    st = getattr(model, "compress_state", None)
+    if st is None:
+        return None
+    out = {k: float(jax.device_get(v)) for k, v in st["acc"].items()}
+    ctrl = st["ctrl"]
+    if "tau" in ctrl:
+        out["tau"] = float(jax.device_get(ctrl["tau"]))
+    return out
+
+
+def measure_compression_overhead(model, repeats: int = 3) -> float:
+    """Time the compression program in ISOLATION: the encode+decode+
+    error-feedback pass jitted alone over a zeros gradient tree of the
+    model's shapes. The in-step cost cannot be isolated host-side (it
+    fuses into the compiled step), so this probe is what feeds the
+    ``grad_compress_ms`` histogram and ``grad_compress`` tracer spans
+    (obs/). Returns best-of-``repeats`` milliseconds. Off the step path —
+    syncs freely."""
+    from deeplearning4j_tpu.obs import Stopwatch
+    from deeplearning4j_tpu.obs.registry import get_registry
+    from deeplearning4j_tpu.obs.trace import get_tracer
+    scheme = model.grad_compression
+    if scheme is None:
+        raise ValueError("model has no grad_compression scheme enabled")
+    state = ensure_compress_state(model)
+    grads = jax.tree_util.tree_map(
+        lambda a: jnp.zeros(a.shape, jnp.float32), model.params)
+    fn = jax.jit(scheme.apply)
+    jax.block_until_ready(fn(grads, state))  # compile outside the clock
+    hist = get_registry().histogram(
+        "grad_compress_ms", unit="ms",
+        help="wall time of one encode+decode+error-feedback pass over the "
+             "full gradient pytree (isolated jitted probe — in-step the "
+             "pass fuses into the compiled train step)")
+    tracer = get_tracer()
+    best = float("inf")
+    for _ in range(max(1, int(repeats))):
+        with tracer.span("grad_compress"):
+            sw = Stopwatch().start()
+            out = fn(grads, state)
+            ms = sw.stop(sync=out) * 1000.0
+        hist.observe(ms)
+        best = min(best, ms)
+    return best

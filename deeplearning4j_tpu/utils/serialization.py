@@ -119,6 +119,8 @@ def snapshot_training_state(model) -> dict:
     else:
         raise TypeError(f"Cannot checkpoint {type(model)}")
     rng = model._rng
+    comp = getattr(model, "grad_compression", None)
+    cs = getattr(model, "compress_state", None)
     return {
         "model_type": model_type,
         "conf_json": model.conf.to_json(),
@@ -128,6 +130,12 @@ def snapshot_training_state(model) -> dict:
         "state": jax.device_get(model.state),
         "opt_state": jax.device_get(model.opt_state),
         "rng": None if rng is None else np.asarray(jax.random.key_data(rng)),
+        # gradient-compression ride-along (parallel/compress.py): the
+        # scheme config lands in metadata and the error-feedback state in
+        # its own npz, so a restored model resumes the compressed run
+        # bitwise (residuals included)
+        "grad_compression": None if comp is None else comp.to_config(),
+        "compress_state": None if cs is None else jax.device_get(cs),
     }
 
 
@@ -147,6 +155,8 @@ def checkpoint_zip_bytes(snap: dict, extra_meta: dict = None) -> bytes:
         "epoch": snap["epoch"],
         "has_updater": snap["opt_state"] is not None,
         "has_rng": snap["rng"] is not None,
+        "grad_compression": snap.get("grad_compression"),
+        "has_compress_state": snap.get("compress_state") is not None,
     }
     meta.update(extra_meta or {})
     buf = io.BytesIO()
@@ -161,6 +171,9 @@ def checkpoint_zip_bytes(snap: dict, extra_meta: dict = None) -> bytes:
         if snap["rng"] is not None:
             z.writestr("rngState.npz",
                        _save_npz_bytes({"key_data": snap["rng"]}))
+        if snap.get("compress_state") is not None:
+            z.writestr("compressState.npz", _save_npz_bytes(
+                _flatten_with_paths(snap["compress_state"])))
     return buf.getvalue()
 
 
@@ -197,9 +210,23 @@ def restore_checkpoint(path, load_updater: bool = True):
             rng = dict(np.load(io.BytesIO(z.read("rngState.npz"))))
             model._rng = jax.random.wrap_key_data(
                 jnp.asarray(rng["key_data"]))
+        if meta.get("grad_compression"):
+            _restore_compression(model, meta, z)
         model.iteration = meta.get("iteration", 0)
         model.epoch = meta.get("epoch", 0)
     return model, meta
+
+
+def _restore_compression(model, meta: dict, z: zipfile.ZipFile):
+    """Rebuild the gradient-compression scheme + error-feedback state from
+    checkpoint metadata via the shared ride-along restore policy
+    (parallel/compress.restore_compress_state)."""
+    from deeplearning4j_tpu.parallel.compress import restore_compress_state
+    arrays = None
+    if meta.get("has_compress_state") and "compressState.npz" in z.namelist():
+        arrays = dict(np.load(io.BytesIO(z.read("compressState.npz"))))
+    restore_compress_state(model, meta["grad_compression"], arrays,
+                           origin="checkpointed")
 
 
 def restore_multi_layer_network(path: str, load_updater: bool = True):

@@ -63,7 +63,17 @@ def _model_factory():
             .layer(OutputLayer(n_out=3, loss="mcxent"))
             .set_input_type(InputType.feed_forward(4))
             .build())
-    return MultiLayerNetwork(conf).init()
+    net = MultiLayerNetwork(conf).init()
+    if CFG.get("grad_compression"):
+        # compressed collectives under elastic membership change: the
+        # scheme also rides the sharded checkpoints, so restored models
+        # re-enable it themselves — the factory only covers the fresh
+        # first-generation model
+        from deeplearning4j_tpu.parallel.compress import (
+            GradientCompression, enable_grad_compression)
+        enable_grad_compression(
+            net, GradientCompression.from_config(CFG["grad_compression"]))
+    return net
 
 
 def _global_batches():

@@ -484,6 +484,67 @@ class TestUnboundedQueue:
         """, path="deeplearning4j_tpu/parallel/thing.py") == []
 
 
+class TestHostWorkInCompression:
+    def test_fires_on_np_in_compress_function_with_device_math(self):
+        vs = _lint("""
+            import numpy as np
+            import jax.numpy as jnp
+            def compress_gradients(grads):
+                v = jnp.abs(grads)
+                return np.asarray(v)
+        """)
+        assert _rules(vs) == ["DLT009"]
+        assert "traced train step" in vs[0].message
+
+    def test_fires_on_item_in_compression_class_method(self):
+        vs = _lint("""
+            import jax.numpy as jnp
+            class MyCompression:
+                def encode(self, v):
+                    tau = jnp.max(jnp.abs(v))
+                    return float(tau.item())
+        """)
+        assert _rules(vs) == ["DLT009"]
+        assert ".item()" in vs[0].message
+
+    def test_fires_on_device_get(self):
+        vs = _lint("""
+            import jax
+            import jax.numpy as jnp
+            def compress_step(g):
+                g = jnp.sign(g)
+                return jax.device_get(g)
+        """)
+        assert _rules(vs) == ["DLT009"]
+
+    def test_pure_host_reader_without_jnp_is_exempt(self):
+        # scrape-time absorbers read the accumulators with numpy but do no
+        # device math — exempt by construction
+        assert _lint("""
+            import numpy as np
+            def absorb_grad_compression(registry, model):
+                acc = model.compress_state["acc"]
+                return {k: float(np.asarray(v)) for k, v in acc.items()}
+        """) == []
+
+    def test_out_of_scope_name_clean(self):
+        assert _lint("""
+            import numpy as np
+            import jax.numpy as jnp
+            def stack_batches(xs):
+                return jnp.asarray(np.stack(xs))
+        """) == []
+
+    def test_inline_waiver(self):
+        assert _lint("""
+            import numpy as np
+            import jax.numpy as jnp
+            def compress_debug(g):
+                v = jnp.abs(g)
+                return np.asarray(v)  # lint: disable=DLT009 (debug dump)
+        """) == []
+
+
 class TestFileWaiver:
     def test_disable_file(self):
         vs = _lint("""

@@ -974,6 +974,54 @@ def test_elastic_chaos_kills_at_boundary_and_midepoch(tmp_path):
 
 
 @pytest.mark.slow
+def test_elastic_membership_change_with_grad_compression(tmp_path):
+    """Compressed collectives × elastic membership (ISSUE 9 satellite):
+    a 4-worker fleet trains with ThresholdCompression; w03 is SIGKILLed
+    at the epoch-2 boundary, survivors re-shard 4→3 and finish — no
+    wedged collective (hard fleet deadline), fleet digests AGREE, and
+    since ``state_sha`` covers the error-feedback residual, agreement
+    proves the residual state was restored consistently across the
+    membership change. Every worker-side restore equals restoring the
+    same journal entry into THIS 1-process world (N→M reshard of the
+    residual per the documented policy)."""
+    from deeplearning4j_tpu.parallel.compress import ThresholdCompression
+    cfg_path, cfg = _elastic_cfg(
+        tmp_path, kill={"w03": {"at_epoch": 2}},
+        grad_compression=ThresholdCompression(
+            target_sparsity=0.05).to_config())
+    ids = [f"w{i:02d}" for i in range(4)]
+    s = _run_elastic_fleet(cfg_path, ids, timeout=360,
+                           respawn_preempted=False,
+                           log_dir=str(tmp_path / "logs"))
+    assert s.completed
+    done = [_out_json(cfg, f"done-w{i:02d}.json") for i in range(3)]
+    assert all(d["epochs"] == cfg["num_epochs"] for d in done)
+    assert len({d["state_sha"] for d in done}) == 1
+    gens = _gen_records(cfg)
+    worlds = {g["generation"]: g["world"] for g in gens}
+    assert max(worlds.values()) == 4 and min(worlds.values()) == 3
+    from deeplearning4j_tpu.checkpoint import (CheckpointManager,
+                                               LocalFSBackend, state_sha)
+    cm = CheckpointManager(
+        storage=LocalFSBackend(os.path.join(cfg["store_dir"], "ckpt")))
+    checked = 0
+    for g in gens:
+        if not g.get("restored_from"):
+            continue
+        local = cm.restore_entry(g["restored_from"].rsplit("/", 1)[-1])
+        # the restored model must carry the scheme + residual state the
+        # digest covers
+        assert local.grad_compression is not None
+        assert local.compress_state is not None
+        assert state_sha(local) == g["state_sha"], \
+            f"world-{g['world']} compressed restore diverged"
+        checked += 1
+    assert checked >= 1  # at least the 4->3 transition restore
+    final = cm.restore_latest()
+    assert state_sha(final) == done[0]["state_sha"]
+
+
+@pytest.mark.slow
 def test_elastic_whole_job_preemption_respawn_is_bitwise(tmp_path):
     """Scheduler-shaped whole-job preemption: BOTH workers SIGKILLed
     mid-epoch, respawned as NEW processes by the supervisor, re-forming
