@@ -63,6 +63,9 @@ NOMINAL = {
     "elastic": 1_000.0,     # ms, nominal membership-transition budget
     "compression": 4.0,     # x, byte-reduction bar for the default
                             # threshold policy (the DCN-win acceptance)
+    "quant": 4.0,           # x, ideal int8 model-byte reduction (the
+                            # acceptance bar is >= 3x after scale/bias
+                            # overhead)
 }
 
 
@@ -524,9 +527,31 @@ def bench_serving_load():
         ep.pi.batch_limit * max(sizes))
     srv.start(warmup_async=False)  # /readyz gating: ladder compiled first
     url = srv.address + "/v1/models/mlp:predict"
-    payloads = [json.dumps(
-        {"inputs": np.zeros((s, n_features), np.float32).tolist()}).encode()
-        for s in sizes]
+    # both predict encodings ride the same load mix: JSON float lists and
+    # the binary wire format (base64 little-endian raw arrays). The byte
+    # accounting below is exact for the request tensors in play; int8 is
+    # the quantized-endpoint payload (same base64 framing, 1 byte/elem).
+    import base64
+
+    rng_x = np.random.default_rng(77)
+    xs = [rng_x.standard_normal((s, n_features)).astype(np.float32)
+          for s in sizes]
+    payloads_json = [json.dumps({"inputs": x.tolist()}).encode()
+                     for x in xs]
+    payloads_b64 = [json.dumps(
+        {"x_b64": base64.b64encode(x.tobytes()).decode(),
+         "dtype": "float32", "shape": list(x.shape)}).encode() for x in xs]
+    int8_bytes = [len(json.dumps(
+        {"x_b64": base64.b64encode(
+            x.astype(np.int8).tobytes()).decode(),
+         "dtype": "int8", "shape": list(x.shape)}).encode()) for x in xs]
+    json_bytes = sum(len(p) for p in payloads_json) / len(sizes)
+    b64_bytes = sum(len(p) for p in payloads_b64) / len(sizes)
+    i8_bytes = sum(int8_bytes) / len(sizes)
+    # alternate encodings request to request: the binary decode path is
+    # exercised under the same offered load as the JSON path
+    payloads = [p for pair in zip(payloads_json, payloads_b64)
+                for p in pair]
     results: list = []
     res_lock = threading.Lock()
 
@@ -564,7 +589,8 @@ def bench_serving_load():
         delay = start + at - time.perf_counter()
         if delay > 0:
             time.sleep(delay)
-        th = threading.Thread(target=fire, args=(payloads[i % len(sizes)],),
+        th = threading.Thread(target=fire,
+                              args=(payloads[i % len(payloads)],),
                               daemon=True)
         th.start()
         threads.append(th)
@@ -593,9 +619,19 @@ def bench_serving_load():
                  if ok_lat else None),
          batch_occupancy=st["batch_size"],
          queue=st["queue"],
+         payload_bytes={
+             "json_f32": round(json_bytes),
+             "b64_f32": round(b64_bytes),
+             "b64_int8": round(i8_bytes),
+             "json_to_b64_x": round(json_bytes / b64_bytes, 2),
+             "json_to_int8_x": round(json_bytes / i8_bytes, 2),
+         },
          note="open-loop seeded Poisson arrivals over HTTP at the offered "
-              "rate (request sizes cycling %s, deadline %gms); shed = 429 "
-              "admission rejections, expired = 504 deadline evictions. "
+              "rate (request sizes cycling %s, deadline %gms, JSON and "
+              "binary-b64 encodings alternating); shed = 429 admission "
+              "rejections, expired = 504 deadline evictions. payload_bytes "
+              "= mean request body size per encoding over the size mix "
+              "(b64_int8 is the quantized-endpoint wire format). "
               "metrics only — thresholds on quiet full runs per the 9p "
               "note. " % (sizes, deadline_ms) + _REPS_NOTE)
 
@@ -932,6 +968,113 @@ def _windows_per_batch(net, batches) -> int:
     return max(1, -(-T // L))
 
 
+def bench_quantized_inference():
+    """Post-training int8 quantization (quant/): fp32 vs BN-folded fp32 vs
+    int8 serving dispatch on the zoo LeNet and a residual conv block —
+    model bytes, per-dispatch p50/p99 and the int8-vs-fp32 accuracy delta.
+    The byte reduction is shape-derived and stable anywhere; dispatch
+    latencies are metrics-only on this host per the 9p/bench-sensitivity
+    note (XLA:CPU has no int8 matmul fast path — the latency story belongs
+    to an MXU run)."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models import LeNet
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.convolutional import ConvolutionLayer
+    from deeplearning4j_tpu.nn.conf.graph import (ElementWiseVertex,
+                                                  GraphBuilder)
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
+                                                   OutputLayer)
+    from deeplearning4j_tpu.nn.conf.normalization import BatchNormalization
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    from deeplearning4j_tpu.perf.fusion import fold_bn
+    from deeplearning4j_tpu.quant import (accuracy_delta, calibrate,
+                                          param_bytes, quantize,
+                                          quantized_layers)
+
+    if QUICK:
+        batch, dispatches, cal_batches, res_hw, res_ch = 8, 6, 2, 8, 8
+    else:
+        batch, dispatches, cal_batches, res_hw, res_ch = 64, 40, 8, 32, 32
+
+    def resnet_block():
+        """One residual conv block (conv-BN-relu ×2 + skip add), the
+        fold_bn→int8 shape ResNet-family serving graphs are made of."""
+        parent = NeuralNetConfiguration.builder()
+        parent.seed(5).updater(Sgd(0.05)).weight_init("relu")
+        g = GraphBuilder(parent)
+        g.add_inputs("in")
+        g.add_layer("c1", ConvolutionLayer(n_out=res_ch, kernel_size=(3, 3),
+                                           convolution_mode="same",
+                                           activation="identity",
+                                           has_bias=False), "in")
+        g.add_layer("b1", BatchNormalization(), "c1")
+        g.add_layer("a1", ActivationLayer(activation="relu"), "b1")
+        g.add_layer("c2", ConvolutionLayer(n_out=res_ch, kernel_size=(3, 3),
+                                           convolution_mode="same",
+                                           activation="identity",
+                                           has_bias=False), "a1")
+        g.add_layer("b2", BatchNormalization(), "c2")
+        g.add_vertex("add", ElementWiseVertex(op="add"), "b2", "a1")
+        g.add_layer("a2", ActivationLayer(activation="relu"), "add")
+        g.add_layer("out", OutputLayer(n_out=10, loss="mcxent"), "a2")
+        g.set_outputs("out")
+        g.set_input_types(InputType.convolutional(res_hw, res_hw, res_ch))
+        return ComputationGraph(g.build()).init()
+
+    rng = np.random.default_rng(7)
+    models = (
+        ("lenet", lambda: LeNet(num_classes=10).init(), (28, 28, 1), 10),
+        ("resnet_block", resnet_block, (res_hw, res_hw, res_ch), 10),
+    )
+    for model_name, make_net, shape, n_classes in models:
+        net = make_net()
+        data = [DataSet(
+            rng.standard_normal((batch,) + shape).astype(np.float32),
+            np.eye(n_classes, dtype=np.float32)[
+                rng.integers(0, n_classes, batch)])
+            for _ in range(cal_batches)]
+        # a few steps of training separate the logits: the accuracy gate
+        # then measures real disagreement, not coin-flips between the
+        # near-tied outputs of a random init
+        net.fit(data, num_epochs=2)
+        record = calibrate(net, (d.features for d in data))
+        qnet = quantize(net, record)
+        variants = (("fp32", net), ("fold_bn", fold_bn(net)),
+                    ("int8", qnet))
+        x = data[0].features
+        results = {}
+        for tag, m in variants:
+            m.output(x)  # compile outside the timed region
+            lat = []
+            for _ in range(dispatches):
+                sw = Stopwatch().start()
+                sw.stop(m.output(x))  # output() is a host array: synced
+                lat.append(sw.seconds * 1000.0)
+            results[tag] = {
+                "model_bytes": param_bytes(m),
+                "p50_ms": round(float(np.percentile(lat, 50)), 2),
+                "p99_ms": round(float(np.percentile(lat, 99)), 2),
+            }
+        reduction = (results["fp32"]["model_bytes"]
+                     / max(results["int8"]["model_bytes"], 1))
+        gate = accuracy_delta(net, qnet, data)
+        emit(f"quantized_inference_{model_name}_byte_reduction_x",
+             float(reduction), "x", "quant",
+             variants=results,
+             quantized_layers=len(quantized_layers(qnet)),
+             top1_delta=round(gate["top1_delta"], 4),
+             top1_agreement=round(gate["top1_agreement"], 4),
+             loss_delta_rel=round(gate["loss_delta_rel"], 5),
+             batch=batch,
+             note="int8 weights + f32 scales/biases vs the fp32 serving "
+                  "graph; acceptance bar is >= 3x bytes with the accuracy "
+                  "delta inside the <= 1% gate budget. Dispatch latencies "
+                  "are metrics-only on this host per the 9p note. "
+                  + _REPS_NOTE)
+
+
 def bench_elastic():
     """Elastic-training path costs, metrics only (no thresholds — the 9p
     filesystem's fsync jitter swings disk-backed numbers run to run;
@@ -1038,6 +1181,7 @@ def main():
                ("resilience", bench_resilience),
                ("elastic", bench_elastic),
                ("grad_compression", bench_grad_compression),
+               ("quantized_inference", bench_quantized_inference),
                ("resnet50_fusion", bench_resnet50_fusion),
                ("resnet50", bench_resnet50)]
     only = os.environ.get("BENCH_ONLY")

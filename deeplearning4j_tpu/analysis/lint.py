@@ -72,6 +72,21 @@ Rules (waivable per line with ``# lint: disable=DLT00X`` or per file with
   compression path; pure-host readers (scrape-time absorbers with no jnp)
   are exempt by construction. Waivable inline like DLT003.
 
+- **DLT010 float-cast-in-quant-path**: int8 quantized-inference code
+  (quant/lowering.py) earns its ~4x by KEEPING tensors int8 until the one
+  per-layer requantize — an ``.astype(jnp.float32)`` / ``.astype(float64)``
+  / ``jnp.float64(...)`` on a tensor inside the quant path silently turns
+  the int8 matmul back into a float one (dequant-per-element in the hot
+  loop) while all tests still pass numerically. Scope: methods of classes
+  named ``*Quantized*`` (quantized layer code is device code by
+  construction), plus functions whose name contains ``quant`` that ALSO
+  use ``jnp``/``lax`` device math — pure-host helpers (bench data prep,
+  CLI loaders) are exempt, the DLT009 precedent. Scalar wraps of Python
+  floats (``jnp.float32(1.0 / s)``) and int casts (``.astype(jnp.int8)``,
+  the quantize itself) are exempt. float64 is flagged anywhere in scope
+  (it defeats both the int8 path and the f32 serving dtype). Waivable
+  inline like DLT003.
+
 Adding a rule: write a ``_rule_xxx(tree, src, path) -> List[LintViolation]``
 function and register it in ``_RULES``; tests in ``tests/test_lint.py``
 seed a fixture violating the rule and assert it fires.
@@ -647,6 +662,85 @@ def _rule_host_work_in_compression(tree, src, path) -> List[LintViolation]:
     return out
 
 
+# ------------------------------------------------------------------ DLT010
+_FLOAT_CAST_TARGETS = {
+    "jax.numpy.float32": "float32", "jax.numpy.float64": "float64",
+    "numpy.float32": "float32", "numpy.float64": "float64",
+}
+
+
+def _rule_float_cast_in_quant(tree, src, path) -> List[LintViolation]:
+    aliases = _import_aliases(tree)
+    out: List[LintViolation] = []
+
+    def uses_device_math(fn) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Attribute, ast.Name)):
+                q = _resolve(_dotted(node), aliases)
+                if q.startswith(("jax.numpy", "jax.lax")):
+                    return True
+        return False
+
+    def in_scope_functions():
+        """(fn, origin) for quant-path functions: any method of a class
+        whose name contains 'Quantized' (quantized layer code is device
+        code by construction), or a function whose name contains 'quant'
+        that ALSO uses jnp/lax device math — pure-host helpers (bench
+        data prep, CLI loaders) are exempt, the DLT009 precedent."""
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and "Quantized" in node.name:
+                for meth in ast.walk(node):
+                    if isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        yield meth, f"{node.name}.{meth.name}"
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and "quant" in node.name.lower() \
+                    and uses_device_math(node):
+                yield node, node.name
+
+    def cast_target(node: ast.Call) -> Optional[str]:
+        """'float32'/'float64' when the call is a flagged float cast."""
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "astype":
+            args = list(node.args) + [kw.value for kw in node.keywords
+                                      if kw.arg in (None, "dtype")]
+            for a in args:
+                if isinstance(a, ast.Constant) and \
+                        a.value in ("float32", "float64"):
+                    return a.value
+                t = _FLOAT_CAST_TARGETS.get(_resolve(_dotted(a), aliases))
+                if t:
+                    return t
+            return None
+        # a float64 CONSTRUCTOR call re-materializes the tensor in f64
+        # (scalar float32 wraps like jnp.float32(1/s) stay exempt — that
+        # is how the requantize multiplier is built)
+        q = _resolve(_dotted(node.func), aliases)
+        if q in ("jax.numpy.float64", "numpy.float64"):
+            return "float64"
+        return None
+
+    seen: Set[int] = set()
+    for fn, origin in in_scope_functions():
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            t = cast_target(node)
+            if t:
+                out.append(LintViolation(
+                    path, node.lineno, "DLT010",
+                    f"{t} cast inside quantized-inference path "
+                    f"'{origin}' — re-floating a tensor mid-path defeats "
+                    "the int8 compute (dequant-per-element in the hot "
+                    "loop) while every numeric test still passes; keep "
+                    "tensors int8 until the single per-layer requantize "
+                    "(or waive inline for a deliberate fp32 boundary)"))
+    return out
+
+
 # ----------------------------------------------------------------- harness
 _RULES = (
     _rule_module_level_jnp,
@@ -658,6 +752,7 @@ _RULES = (
     _rule_metric_registration,
     _rule_unbounded_queue,
     _rule_host_work_in_compression,
+    _rule_float_cast_in_quant,
 )
 
 

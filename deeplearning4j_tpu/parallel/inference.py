@@ -107,6 +107,15 @@ class ParallelInference:
     caller's model object is untouched; exact within fp tolerance
     (analysis/lint.py DLT005 flags serving sites that skip this).
 
+    quantize: a ``quant.CalibrationRecord`` — serve an int8-quantized COPY
+    of the model (``quant.quantize``, which BN-folds first): per-channel
+    int8 weights, calibrated per-tensor activation scales, int32
+    accumulation. The quantized graph shares the bucket ladder and
+    ``warmup()`` unchanged, and checkpoint hot-swap re-applies the SAME
+    record to every newer fp32 checkpoint it swaps in, so a training
+    job's commits keep serving quantized (see quant/ docs for the
+    accuracy-gate step that should precede this).
+
     checkpoint hot-swap: ``start_hot_swap(checkpoint_manager)`` watches the
     manager's journal for a newer step and atomically swaps the new params
     in BETWEEN dispatches — no request is dropped, none observes a
@@ -121,7 +130,7 @@ class ParallelInference:
                  queue_timeout_ms: int = 5, inference_mode: str = "batched",
                  bucket_policy=_DEFAULT_POLICY,
                  batch_size_history: int = 1024, fold_bn: bool = False,
-                 checkpoint_manager=None,
+                 quantize=None, checkpoint_manager=None,
                  checkpoint_poll_secs: Optional[float] = None,
                  queue_depth: int = 1024,
                  queue_put_timeout_ms: float = 50.0):
@@ -132,14 +141,20 @@ class ParallelInference:
         if queue_put_timeout_ms < 0:
             raise ValueError("queue_put_timeout_ms must be >= 0")
         self._fold_bn = bool(fold_bn)
-        # read checkpoint provenance BEFORE folding: fold_bn rebuilds the
-        # model and does not carry _restored_from over, and losing it here
-        # would make the first hot-swap poll re-swap the very checkpoint
-        # this server already serves
+        self._quantize = quantize
+        # read checkpoint provenance BEFORE folding/quantizing: both
+        # rebuild the model and do not carry _restored_from over, and
+        # losing it here would make the first hot-swap poll re-swap the
+        # very checkpoint this server already serves
         restored_from = getattr(model, "_restored_from", None)
-        if fold_bn:
+        if quantize is not None:
+            from deeplearning4j_tpu.quant import quantize as _quantize_net
+            model = _quantize_net(model, quantize)  # BN-folds internally
+        elif fold_bn:
             from deeplearning4j_tpu.perf.fusion import fold_bn as _fold_bn
             model = _fold_bn(model)
+        from deeplearning4j_tpu.quant.lowering import is_quantized
+        self.quantized = is_quantized(model)
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.batch_limit = batch_limit
@@ -434,7 +449,13 @@ class ParallelInference:
         if self.current_checkpoint_step is not None \
                 and restored_step <= self.current_checkpoint_step:
             return False
-        if self._fold_bn:
+        if self._quantize is not None:
+            # the newer (fp32) checkpoint gets the SAME lowering this
+            # server was built with: quantize folds + int8-lowers, so the
+            # swapped-in tree matches the serving model's structurally
+            from deeplearning4j_tpu.quant import quantize as _quantize_net
+            restored = _quantize_net(restored, self._quantize)
+        elif self._fold_bn:
             from deeplearning4j_tpu.perf.fusion import fold_bn as _fold_bn
             restored = _fold_bn(restored)
         if (jax.tree_util.tree_structure(restored.params)
@@ -493,6 +514,7 @@ class ParallelInference:
         out = {
             "requests_served": requests_served,
             "batches_dispatched": batches_dispatched,
+            "quantized": self.quantized,
             "queue": {
                 "depth": self.queue_depth,
                 "size": self._q.qsize(),

@@ -1,0 +1,40 @@
+"""Post-training int8 quantization for serving graphs.
+
+The standard PTQ pipeline (Jacob et al. 2018; Nagel et al. 2021) on the
+BN-folded serving graph:
+
+1. **Calibrate** — ``calibrate(net, batches)`` drives a representative
+   stream through the real inference forward and records per-layer
+   activation ranges (min/max or percentile observers) into a
+   serializable, deterministic :class:`CalibrationRecord`.
+2. **Quantize** — ``quantize(net, record)`` lowers every dense/conv/output
+   layer to per-channel symmetric int8 weights + per-tensor static int8
+   activations with int32 accumulation and one requantize per layer; all
+   other layers (LSTM/VAE/custom) stay fp32 behind explicit dequant
+   boundaries. The result is an ordinary network: same predict surface,
+   same serving bucket ladder, ~4x smaller parameters.
+3. **Gate** — ``accuracy_delta(fp32, q, data)`` +
+   ``assert_accuracy_within`` check top-1/loss deltas against a stated
+   budget before the artifact ships.
+4. **Serve** — ``ParallelInference(quantize=record)`` /
+   ``ModelServer.add_model(..., quantize=record)`` quantize at load AND on
+   every checkpoint hot-swap; the model zip carries int8 weights, scales
+   and the calibration record (``quantization.json``), so restore rebuilds
+   the exact quantized predict. ``tools/quantize.py`` is the offline CLI.
+"""
+
+from deeplearning4j_tpu.quant.calibrate import (  # noqa: F401
+    CalibrationRecord, calibrate,
+)
+from deeplearning4j_tpu.quant.gates import (  # noqa: F401
+    accuracy_delta, assert_accuracy_within,
+)
+from deeplearning4j_tpu.quant.lowering import (  # noqa: F401
+    QuantizedConvolution1DLayer, QuantizedConvolutionLayer,
+    QuantizedDenseLayer, QuantizedOutputLayer, input_quant_scale,
+    is_quantized, param_bytes, quantizable_kind, quantize,
+    quantized_layers, quantize_weights,
+)
+from deeplearning4j_tpu.quant.observers import (  # noqa: F401
+    MinMaxObserver, PercentileObserver, make_observer,
+)

@@ -1,0 +1,247 @@
+"""Calibration: drive a representative batch stream through the network's
+REAL inference forward and record per-layer activation ranges.
+
+The driver runs the same ``_forward`` the serving path runs (eval mode, on
+the BN-folded graph — quantization targets the serving graph, so ranges
+must be measured on it), then reads each quantizable layer's input straight
+out of the activation dict: the input of layer ``i`` is the previous
+layer's output (or the network input), passed through the layer's input
+preprocessor — exactly what ``layer.apply`` will see at serving time. Per
+batch, ONE jitted program returns a 3-float statistics vector
+``[min, max, percentile(|x|, p)]`` per slot; the host-side observers
+(quant/observers.py) aggregate across the stream.
+
+The output is a :class:`CalibrationRecord`: a serializable (JSON) map of
+per-layer ranges/scales plus a structural signature of the graph it was
+measured on. ``quantize()`` refuses a record whose signature does not match
+the network being lowered — a calibration is only valid for the graph shape
+it ran on. Deterministic: same seed + same stream ⇒ bitwise-identical
+record (asserted in tests/test_quant.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.quant.observers import make_observer
+
+__all__ = ["CalibrationRecord", "calibrate"]
+
+CALIBRATION_FORMAT_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class CalibrationRecord:
+    """Per-layer activation ranges + scales for one concrete serving graph.
+
+    ``signature`` pins the graph shape the ranges were measured on: a tuple
+    of ``(slot_key, source_layer_class, n_out)`` triples in forward order, where
+    ``slot_key`` is ``"layer<i>"`` for MultiLayerNetwork stacks and the
+    vertex name for ComputationGraph DAGs. ``ranges`` maps slot key to
+    ``{"min", "max", "amax", "scale", "zero_point"}`` (zero_point is always
+    0 — symmetric quantization). Rides along in the model zip as
+    ``quantization.json`` (utils/serialization) so a restored quantized
+    model can rebuild — and a serving replica can re-apply — the exact same
+    lowering."""
+
+    model_type: str
+    observer: str
+    percentile: Optional[float]
+    batches: int
+    signature: Tuple[Tuple[str, str, int], ...]
+    ranges: Dict[str, Dict[str, float]]
+
+    def scale(self, key: str) -> float:
+        return float(self.ranges[key]["scale"])
+
+    def to_dict(self) -> dict:
+        return {
+            "format_version": CALIBRATION_FORMAT_VERSION,
+            "model_type": self.model_type,
+            "observer": self.observer,
+            "percentile": self.percentile,
+            "batches": self.batches,
+            "signature": [list(p) for p in self.signature],
+            "ranges": self.ranges,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CalibrationRecord":
+        return cls(
+            model_type=d["model_type"],
+            observer=d["observer"],
+            percentile=d.get("percentile"),
+            batches=int(d.get("batches", 0)),
+            signature=tuple((str(p[0]), str(p[1]), int(p[2]))
+                            for p in d["signature"]),
+            ranges={str(k): dict(v) for k, v in d["ranges"].items()},
+        )
+
+    def to_json(self) -> str:
+        # sorted keys: two equal records serialize to IDENTICAL bytes, the
+        # determinism contract the tests assert at the JSON level
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, s: str) -> "CalibrationRecord":
+        return cls.from_dict(json.loads(s))
+
+    def save(self, path: str):
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(self.to_json())
+
+    @classmethod
+    def load(cls, path: str) -> "CalibrationRecord":
+        with open(path, encoding="utf-8") as f:
+            return cls.from_json(f.read())
+
+
+# ------------------------------------------------------------------ slots
+def _quant_slots(net) -> List[Tuple[str, object]]:
+    """(slot_key, layer) for every quantizable layer of a network, in
+    forward order (quant/lowering.py owns what counts as quantizable)."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.quant.lowering import quantizable_kind
+
+    if isinstance(net, MultiLayerNetwork):
+        return [(f"layer{i}", l) for i, l in enumerate(net.layers)
+                if quantizable_kind(l) is not None]
+    if isinstance(net, ComputationGraph):
+        from deeplearning4j_tpu.nn.conf.layers import Layer
+        out = []
+        for name in net.order:
+            obj, _ = net.vertices[name]
+            if isinstance(obj, Layer) and quantizable_kind(obj) is not None:
+                out.append((name, obj))
+        return out
+    raise TypeError(f"calibrate() expects a network, got "
+                    f"{type(net).__name__}")
+
+
+def signature_of(net) -> Tuple[Tuple[str, str, int], ...]:
+    return tuple((k, type(l).__name__, int(l.n_out or 0))
+                 for k, l in _quant_slots(net))
+
+
+def _stat_vec(x, p: float):
+    """[min, max, percentile(|x|, p)] of one activation tensor, f32."""
+    xf = jnp.asarray(x)
+    return jnp.stack([jnp.min(xf), jnp.max(xf),
+                      jnp.percentile(jnp.abs(xf), p)])
+
+
+def _mln_stats_fn(net, slot_idxs: List[int], p: float):
+    def fn(params, state, x):
+        acts = net._forward(params, state, x, False, None, None)[0]
+        outs = []
+        for i in slot_idxs:
+            xin = x if i == 0 else acts[i - 1]
+            if i in net._pre:
+                xin, _ = net._pre[i].apply(xin, None)
+            outs.append(_stat_vec(xin, p))
+        return outs
+
+    return jax.jit(fn)
+
+
+def _graph_stats_fn(net, slot_names: List[str], p: float):
+    def fn(params, state, inputs):
+        acts = net._forward(params, state, inputs, False, None, None)[0]
+        outs = []
+        for name in slot_names:
+            _, ins = net.vertices[name]
+            xin = acts[ins[0]]
+            if name in net._vpre:
+                xin, _ = net._vpre[name].apply(xin, None)
+            outs.append(_stat_vec(xin, p))
+        return outs
+
+    return jax.jit(fn)
+
+
+def _batch_features(net, item):
+    """Coerce one stream item (DataSet / MultiDataSet / array / sequence of
+    arrays) to the forward's input form."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    if isinstance(net, ComputationGraph):
+        if isinstance(item, MultiDataSet):
+            feats = item.features
+        elif isinstance(item, DataSet):
+            feats = [item.features]
+        elif isinstance(item, (list, tuple)):
+            feats = list(item)
+        else:
+            feats = [item]
+        return [jnp.asarray(f) for f in feats]
+    if isinstance(item, DataSet):
+        return jnp.asarray(item.features)
+    return jnp.asarray(item)
+
+
+def calibrate(net, data, observer: str = "minmax",
+              percentile: float = 99.99, max_batches: Optional[int] = None,
+              fold: bool = True) -> CalibrationRecord:
+    """Measure per-layer activation ranges over a representative stream.
+
+    ``net``: a MultiLayerNetwork or ComputationGraph (initialized or not).
+    ``data``: an iterable of DataSets / MultiDataSets / feature arrays —
+    the same iterator shapes ``evaluate()`` takes; labels are ignored.
+    ``observer``: ``"minmax"`` or ``"percentile"`` (see quant/observers).
+    ``fold=True`` measures on the BN-folded serving graph (perf/fusion
+    ``fold_bn``) — the graph ``quantize()`` will lower — so ranges line up
+    with the layers that will consume them; pass ``fold=False`` only for a
+    net that is already folded/BN-free AND will be quantized with
+    ``quantize(..., fold=False)``.
+
+    Returns a :class:`CalibrationRecord`. Raises if the network has no
+    quantizable layer at all."""
+    if net.params is None:
+        net.init()
+    if fold:
+        from deeplearning4j_tpu.perf.fusion import fold_bn
+        net = fold_bn(net)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    slots = _quant_slots(net)
+    if not slots:
+        raise ValueError(
+            "calibrate(): no quantizable layer (dense/conv/output) in this "
+            "network — nothing to measure; LSTM/VAE/custom layers serve in "
+            "fp32 and need no calibration")
+    obs = {k: make_observer(observer, percentile) for k, _ in slots}
+    p = next(iter(obs.values())).percentile
+    if isinstance(net, MultiLayerNetwork):
+        idxs = [int(k[len("layer"):]) for k, _ in slots]
+        fn = _mln_stats_fn(net, idxs, p)
+    else:
+        fn = _graph_stats_fn(net, [k for k, _ in slots], p)
+    n = 0
+    for item in data:
+        if max_batches is not None and n >= max_batches:
+            break
+        stats = fn(net.params, net.state, _batch_features(net, item))
+        # host conversion is ONCE per batch over 3 floats per slot —
+        # calibration is an offline pass, not a serving hot path
+        for (k, _), vec in zip(slots, stats):
+            v = np.asarray(vec)
+            obs[k].update(float(v[0]), float(v[1]), float(v[2]))
+        n += 1
+    if n == 0:
+        raise ValueError("calibrate(): empty batch stream")
+    return CalibrationRecord(
+        model_type=type(net).__name__,
+        observer=observer,
+        percentile=(float(percentile) if observer == "percentile" else None),
+        batches=n,
+        signature=signature_of(net),
+        ranges={k: o.entry() for k, o in obs.items()},
+    )

@@ -1,0 +1,130 @@
+"""Activation-range observers for post-training quantization calibration.
+
+The calibration driver (quant/calibrate.py) computes a tiny per-batch
+statistics vector for every quantizable layer input ON DEVICE — one jitted
+reduction per batch, ``[min, max, percentile(|x|, p)]`` — and feeds it to a
+host-side observer, which aggregates across the batch stream and finally
+produces the activation quantization scale. Two observers, the standard PTQ
+pair (Jacob et al. 2018; Nagel et al. 2021 §3):
+
+- :class:`MinMaxObserver` — scale from the absolute extrema seen anywhere
+  in the stream: ``scale = max(|min|, |max|) / 127``. Never clips, but a
+  single outlier activation inflates the scale (and so the rounding error)
+  for every other value.
+- :class:`PercentileObserver` — scale from the mean per-batch percentile of
+  ``|x|`` (default 99.99): ``scale = mean_batches(pct(|x|, p)) / 127``.
+  Deliberately clips the outlier tail in exchange for finer resolution in
+  the bulk — the usual accuracy win on heavy-tailed activations.
+
+Both are exactly deterministic: same seed + same batch stream ⇒ the same
+floats, bitwise (the per-batch reductions are compiled XLA programs; host
+aggregation is plain float arithmetic in stream order).
+
+Quantization here is SYMMETRIC (zero_point = 0 always): the int8 grid is
+centered so conv/matmul padding and zero inputs stay exact, and the
+quantized kernels need no zero-point cross terms.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+__all__ = ["Observer", "MinMaxObserver", "PercentileObserver",
+           "make_observer", "OBSERVERS"]
+
+# int8 symmetric grid: values quantize to [-127, 127] (the -128 code is
+# unused so the grid is symmetric and negation is exact)
+QMAX = 127.0
+
+_SCALE_FLOOR = 1e-12  # an all-zero activation still needs a nonzero scale
+
+
+class Observer:
+    """Aggregates per-batch ``(min, max, pct_amax)`` stats into a scale."""
+
+    kind = "base"
+    #: percentile the device-side reduction should compute for this
+    #: observer (100.0 = plain max|x|)
+    percentile = 100.0
+
+    def __init__(self):
+        self.batches = 0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def update(self, mn: float, mx: float, pct_amax: float):
+        self.batches += 1
+        self.min = mn if self.min is None else min(self.min, mn)
+        self.max = mx if self.max is None else max(self.max, mx)
+        self._update_amax(pct_amax)
+
+    def _update_amax(self, pct_amax: float):
+        raise NotImplementedError
+
+    def amax(self) -> float:
+        raise NotImplementedError
+
+    def scale(self) -> float:
+        return max(self.amax(), _SCALE_FLOOR) / QMAX
+
+    def entry(self) -> Dict[str, float]:
+        """The serializable per-layer record: observed range, the effective
+        clipping amax, the derived scale, and the (always-zero) zero point."""
+        return {"min": float(self.min), "max": float(self.max),
+                "amax": float(self.amax()), "scale": float(self.scale()),
+                "zero_point": 0}
+
+
+class MinMaxObserver(Observer):
+    """scale = max(|min|, |max|) / 127 over the whole stream."""
+
+    kind = "minmax"
+    percentile = 100.0
+
+    def __init__(self):
+        super().__init__()
+        self._amax = 0.0
+
+    def _update_amax(self, pct_amax: float):
+        # pct_amax at p=100 IS max|x| of the batch
+        self._amax = max(self._amax, float(pct_amax))
+
+    def amax(self) -> float:
+        return self._amax
+
+
+class PercentileObserver(Observer):
+    """scale = mean over batches of percentile(|x|, p) / 127.
+
+    The mean (not the max) of per-batch percentiles is the aggregation of
+    the classic PTQ recipe: robust to a single pathological batch, still a
+    consistent estimator of the distribution's p-quantile."""
+
+    kind = "percentile"
+
+    def __init__(self, percentile: float = 99.99):
+        super().__init__()
+        if not 0.0 < percentile <= 100.0:
+            raise ValueError(f"percentile must be in (0, 100]; got "
+                             f"{percentile}")
+        self.percentile = float(percentile)
+        self._sum = 0.0
+
+    def _update_amax(self, pct_amax: float):
+        self._sum += float(pct_amax)
+
+    def amax(self) -> float:
+        return self._sum / self.batches if self.batches else 0.0
+
+
+OBSERVERS = {"minmax": MinMaxObserver, "percentile": PercentileObserver}
+
+
+def make_observer(name: str, percentile: float = 99.99) -> Observer:
+    """Observer factory for the calibrate() string API."""
+    if name == "minmax":
+        return MinMaxObserver()
+    if name == "percentile":
+        return PercentileObserver(percentile)
+    raise ValueError(f"Unknown observer '{name}' "
+                     f"(known: {sorted(OBSERVERS)})")

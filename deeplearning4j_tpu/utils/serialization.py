@@ -9,6 +9,11 @@ Zip layout mirrors the reference's:
 - ``coefficients.npz``    — flat numpy archive of all params
 - ``updaterState.npz``    — optimizer state (saved when save_updater=True)
 - ``metadata.json``       — model class, iteration/epoch counters, format version
+- ``quantization.json``   — quant/ calibration record (present iff the model
+  is an int8-quantized serving graph; the int8 weights + scales already
+  live in the config/coefficients entries, so restore rebuilds the exact
+  quantized predict and this record lets serving re-apply the SAME
+  lowering to newer fp32 checkpoints)
 
 The checkpoint/ subsystem extends this layout with ``rngState.npz`` (the
 training PRNG key via ``jax.random.key_data``) and extra metadata
@@ -91,6 +96,7 @@ def write_model(model, path: str, save_updater: bool = True):
         "epoch": model.epoch,
         "has_updater": bool(save_updater),
     }
+    cal = getattr(model, "_quant_calibration", None)
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
         z.writestr("configuration.json", model.conf.to_json())
         z.writestr("metadata.json", json.dumps(meta))
@@ -99,6 +105,8 @@ def write_model(model, path: str, save_updater: bool = True):
         if save_updater:
             z.writestr("updaterState.npz",
                        _save_npz_bytes(_flatten_with_paths(model.opt_state)))
+        if cal is not None:
+            z.writestr("quantization.json", cal.to_json())
 
 
 def snapshot_training_state(model) -> dict:
@@ -121,7 +129,12 @@ def snapshot_training_state(model) -> dict:
     rng = model._rng
     comp = getattr(model, "grad_compression", None)
     cs = getattr(model, "compress_state", None)
+    cal = getattr(model, "_quant_calibration", None)
     return {
+        # quant/ ride-along: a checkpointed QUANTIZED serving model (its
+        # int8 weights are ordinary params) restores with the calibration
+        # record it was lowered with
+        "quant_calibration": None if cal is None else cal.to_dict(),
         "model_type": model_type,
         "conf_json": model.conf.to_json(),
         "iteration": int(model.iteration),
@@ -157,6 +170,7 @@ def checkpoint_zip_bytes(snap: dict, extra_meta: dict = None) -> bytes:
         "has_rng": snap["rng"] is not None,
         "grad_compression": snap.get("grad_compression"),
         "has_compress_state": snap.get("compress_state") is not None,
+        "has_quant_calibration": snap.get("quant_calibration") is not None,
     }
     meta.update(extra_meta or {})
     buf = io.BytesIO()
@@ -174,6 +188,9 @@ def checkpoint_zip_bytes(snap: dict, extra_meta: dict = None) -> bytes:
         if snap.get("compress_state") is not None:
             z.writestr("compressState.npz", _save_npz_bytes(
                 _flatten_with_paths(snap["compress_state"])))
+        if snap.get("quant_calibration") is not None:
+            z.writestr("quantization.json",
+                       json.dumps(snap["quant_calibration"], sort_keys=True))
     return buf.getvalue()
 
 
@@ -212,9 +229,20 @@ def restore_checkpoint(path, load_updater: bool = True):
                 jnp.asarray(rng["key_data"]))
         if meta.get("grad_compression"):
             _restore_compression(model, meta, z)
+        _restore_quant_calibration(model, z)
         model.iteration = meta.get("iteration", 0)
         model.epoch = meta.get("epoch", 0)
     return model, meta
+
+
+def _restore_quant_calibration(model, z: zipfile.ZipFile):
+    """Re-attach the quant/ calibration record when one rides in the zip
+    (the quantized layers themselves round-trip through the config JSON +
+    coefficients like any other layer)."""
+    if "quantization.json" in z.namelist():
+        from deeplearning4j_tpu.quant.calibrate import CalibrationRecord
+        model._quant_calibration = CalibrationRecord.from_json(
+            z.read("quantization.json").decode())
 
 
 def _restore_compression(model, meta: dict, z: zipfile.ZipFile):
@@ -264,6 +292,7 @@ def _restore(path, expect, load_updater):
         if load_updater and meta.get("has_updater") and "updaterState.npz" in z.namelist():
             upd = dict(np.load(io.BytesIO(z.read("updaterState.npz"))))
             model.opt_state = _restore_into(model.opt_state, upd)
+        _restore_quant_calibration(model, z)
         model.iteration = meta.get("iteration", 0)
         model.epoch = meta.get("epoch", 0)
     return model

@@ -545,6 +545,72 @@ class TestHostWorkInCompression:
         """) == []
 
 
+class TestFloatCastInQuant:
+    def test_fires_on_astype_float32_in_quant_function(self):
+        vs = _lint("""
+            import jax.numpy as jnp
+            def dequantize_layer(xq, scale):
+                return xq.astype(jnp.float32) * scale
+        """)
+        assert _rules(vs) == ["DLT010"]
+        assert "int8 compute" in vs[0].message
+
+    def test_fires_on_string_dtype_and_quantized_class_method(self):
+        vs = _lint("""
+            import jax.numpy as jnp
+            class QuantizedThingLayer:
+                def apply(self, params, x):
+                    acc = x @ params["Wq"]
+                    return acc.astype("float64")
+        """)
+        assert _rules(vs) == ["DLT010"]
+        assert "float64" in vs[0].message
+
+    def test_fires_on_float64_constructor(self):
+        vs = _lint("""
+            import numpy as np
+            import jax.numpy as jnp
+            def quantize_weights(w):
+                wq = jnp.round(w)
+                return np.float64(wq) / 127.0
+        """)
+        assert _rules(vs) == ["DLT010"]
+
+    def test_pure_host_quant_helper_exempt(self):
+        # bench/CLI data prep named *quant* with no device math — the
+        # DLT009 precedent: host-on-host casts are not the int8 hot path
+        assert _lint("""
+            import numpy as np
+            def bench_quantized_inference():
+                rng = np.random.default_rng(7)
+                return rng.standard_normal((8, 4)).astype(np.float32)
+        """) == []
+
+    def test_int_casts_and_scalar_wraps_exempt(self):
+        # the quantize itself (.astype(int8)) and the scalar requantize
+        # multiplier (jnp.float32 of a Python float) are the legal idiom
+        assert _lint("""
+            import jax.numpy as jnp
+            def quantize_activation(x, s):
+                inv = jnp.float32(1.0 / s)
+                return jnp.clip(jnp.round(x * inv), -127, 127).astype(jnp.int8)
+        """) == []
+
+    def test_out_of_scope_name_clean(self):
+        assert _lint("""
+            import jax.numpy as jnp
+            def upcast_batch(x):
+                return x.astype(jnp.float32)
+        """) == []
+
+    def test_inline_waiver(self):
+        assert _lint("""
+            import jax.numpy as jnp
+            def quantized_fallback(x):
+                return x.astype(jnp.float32)  # lint: disable=DLT010 (fp32 boundary)
+        """) == []
+
+
 class TestFileWaiver:
     def test_disable_file(self):
         vs = _lint("""

@@ -668,7 +668,12 @@ def test_bench_serving_load_quick_smoke():
     assert load["value"] > 0
     assert {"offered_rps", "arrivals", "ok", "shed", "expired",
             "shed_rate", "expired_rate", "p50_ms", "p99_ms",
-            "batch_occupancy", "queue"} <= set(load)
+            "batch_occupancy", "queue", "payload_bytes"} <= set(load)
+    # the binary wire format pays: raw-b64 f32 beats JSON floats ~3-4x,
+    # int8 another ~4x on top (shape-derived, stable anywhere)
+    pb = load["payload_bytes"]
+    assert pb["json_to_b64_x"] >= 3.0
+    assert pb["json_to_int8_x"] >= 10.0
     # open loop accounting: every arrival got a terminal classification
     assert load["ok"] + load["shed"] + load["expired"] + load["other"] \
         == load["arrivals"]

@@ -58,6 +58,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="checkpoint hot-swap poll cadence (off when unset)")
     p.add_argument("--fold-bn", action="store_true",
                    help="serve BN-folded copies (perf.fusion.fold_bn)")
+    p.add_argument("--quantize", action="store_true",
+                   help="serve int8-quantized copies (quant/): each model's "
+                        "checkpoint dir must hold a calibration.json "
+                        "(written by tools/quantize.py --save-calibration); "
+                        "hot-swapped checkpoints are re-quantized with the "
+                        "same record")
     p.add_argument("--drain-timeout-s", type=float, default=30.0)
     return p
 
@@ -80,12 +86,31 @@ def main(argv=None) -> int:
             print(f"error: no restorable checkpoint in {ckpt_dir!r} "
                   f"for model '{name}'", file=sys.stderr)
             return 2
-        server.add_model(name, net, fold_bn=args.fold_bn,
-                         checkpoint_manager=cm,
-                         checkpoint_poll_secs=args.poll_secs)
+        record = None
+        if args.quantize:
+            from deeplearning4j_tpu.quant import CalibrationRecord
+            cal_path = os.path.join(ckpt_dir, "calibration.json")
+            if not os.path.exists(cal_path):
+                print(f"error: --quantize needs {cal_path!r} — run "
+                      f"tools/quantize.py --ckpt {ckpt_dir} "
+                      "--save-calibration first", file=sys.stderr)
+                return 2
+            record = CalibrationRecord.load(cal_path)
+        try:
+            server.add_model(name, net, fold_bn=args.fold_bn,
+                             quantize=record, checkpoint_manager=cm,
+                             checkpoint_poll_secs=args.poll_secs)
+        except (ValueError, TypeError) as e:
+            # e.g. a stale calibration.json measured on a different
+            # architecture than the checkpoint now restores to
+            print(f"error: cannot serve model '{name}': {e}",
+                  file=sys.stderr)
+            return 2
         step = net._restored_from.step
         print(f"model '{name}': serving checkpoint step {step} "
-              f"from {ckpt_dir}", flush=True)
+              f"from {ckpt_dir}"
+              + (" (int8-quantized)" if record is not None else ""),
+              flush=True)
 
     server.start(warmup=False)  # no example shape on file: first-request
     print(f"serving {len(server.endpoints)} model(s) on "
