@@ -66,6 +66,12 @@ NOMINAL = {
     "quant": 4.0,           # x, ideal int8 model-byte reduction (the
                             # acceptance bar is >= 3x after scale/bias
                             # overhead)
+    "data_plane": 1_000_000.0,  # records/sec, nominal host-side ETL
+                                # throughput for small-record corpora
+    "data_plane_claim": 1_000.0,  # us, nominal one-RTT object-store
+                                  # lease claim budget
+    "data_plane_wait": 10.0,    # %, nominal data-wait share of a fit
+                                # epoch before prefetch tuning
 }
 
 
@@ -1173,6 +1179,116 @@ def bench_elastic():
               "note. " + _REPS_NOTE)
 
 
+def bench_data_plane():
+    """Streaming data plane costs, metrics only (9p note: the lease path
+    here runs over the in-process object store, so the numbers isolate
+    protocol overhead from disk jitter; thresholds belong to quiet full
+    runs): (1) host ETL records/s — plain list iterator vs the sharded
+    reader vs the sharded reader with leases + consumption ledger; (2)
+    record-range lease claim latency; (3) data-wait fraction of a real
+    fit loop over the sharded reader, with and without async prefetch,
+    via the train.data_wait spans."""
+    import jax
+
+    from deeplearning4j_tpu.checkpoint import ObjectStoreBackend
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import (
+        AsyncDataSetIterator, ListDataSetIterator)
+    from deeplearning4j_tpu.datasets.sharded import (ShardedDataset,
+                                                     ShardLeaseBoard)
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.obs import trace as obs_trace
+    from deeplearning4j_tpu.optimize.updaters import Adam
+
+    rng = np.random.default_rng(23)
+    n = 4096 if QUICK else 65536
+    batch = 256
+    x = rng.standard_normal((n, 64)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, n)]
+
+    def drain(it):
+        # pure host ETL, no device work to sync
+        t0 = time.perf_counter()  # lint: disable=DLT003
+        count = 0
+        for ds in it:
+            count += ds.num_examples()
+        return count / (time.perf_counter() - t0)
+
+    plain = ListDataSetIterator(DataSet(x, y), batch)
+    plain_rps = max(drain(plain) for _ in range(REPS))
+    sds = ShardedDataset(x, y, batch_size=batch, seed=5)
+    reader_rps = max(drain(sds.reader()) for _ in range(REPS))
+    store = ObjectStoreBackend()
+    sds_leased = ShardedDataset(x, y, batch_size=batch, seed=5,
+                                store=store, ledger=True, lease_batches=8)
+    leased_rps = max(drain(sds_leased.reader()) for _ in range(REPS))
+    emit("data_plane_records_per_sec", reader_rps, "records/sec",
+         "data_plane", plain_iterator=round(plain_rps, 1),
+         leased_ledgered=round(leased_rps, 1), batch=batch, records=n,
+         note="host ETL drain of the sharded reader (shuffle plan + row "
+              "gather); plain_iterator is the pre-sharding baseline, "
+              "leased_ledgered adds the lease protocol + per-batch "
+              "consumption ledger over an in-process object store. "
+              + _REPS_NOTE)
+
+    # --- lease-claim latency ------------------------------------------
+    board = ShardLeaseBoard(ObjectStoreBackend(), "bench-worker",
+                            ttl_s=30.0)
+    claims = 64 if QUICK else 512
+
+    def claim_all():
+        # host-side storage protocol, no device work to sync
+        t0 = time.perf_counter()  # lint: disable=DLT003
+        for c in range(claims):
+            board.claim(0, c, 0, 1)
+        return (time.perf_counter() - t0) / claims
+    claim_us = _best_of(claim_all) * 1e6
+    emit("data_plane_lease_claim_us", claim_us, "us", "data_plane_claim",
+         claims=claims,
+         note="one record-range lease claim (conflict scan + put + "
+              "read-back) on an in-process object store; real object "
+              "stores add their RTT. " + _REPS_NOTE)
+
+    # --- data-wait fraction of a real fit loop ------------------------
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater(Adam(0.01)).weight_init("xavier").list()
+            .layer(DenseLayer(n_out=256, activation="relu"))
+            .layer(OutputLayer(n_out=10, loss="mcxent"))
+            .set_input_type(InputType.feed_forward(64))
+            .build())
+
+    def wait_fraction(wrap):
+        net = MultiLayerNetwork(conf).init()
+        spans = []
+        tracer = obs_trace.Tracer(enabled=True)
+        tracer.add_sink(spans.append)
+        old = obs_trace._global
+        obs_trace._global = tracer
+        try:
+            reader = ShardedDataset(x, y, batch_size=batch, seed=5).reader()
+            t0 = time.perf_counter()
+            net.fit(wrap(reader), num_epochs=1)
+            jax.block_until_ready(net.params)
+            total_ms = (time.perf_counter() - t0) * 1000.0
+        finally:
+            obs_trace._global = old
+        wait_ms = sum(r["dur_ms"] for r in spans
+                      if r["kind"] == "span"
+                      and r["name"] == "train.data_wait")
+        return wait_ms / max(total_ms, 1e-9)
+    frac_sync = wait_fraction(lambda r: r)
+    frac_async = wait_fraction(AsyncDataSetIterator)
+    emit("data_plane_data_wait_fraction", frac_sync * 100.0, "%",
+         "data_plane_wait",
+         async_prefetch_pct=round(frac_async * 100.0, 2),
+         note="share of one fit epoch spent waiting on the sharded "
+              "reader (train.data_wait spans / wall); async_prefetch_pct "
+              "is the same loop under AsyncDataSetIterator. metrics "
+              "only — thresholds on quiet full runs per the 9p note.")
+
+
 def main():
     benches = [("lenet", bench_lenet), ("word2vec", bench_word2vec),
                ("charlstm", bench_graveslstm), ("serving", bench_serving),
@@ -1180,6 +1296,7 @@ def main():
                ("checkpoint", bench_checkpoint),
                ("resilience", bench_resilience),
                ("elastic", bench_elastic),
+               ("data_plane", bench_data_plane),
                ("grad_compression", bench_grad_compression),
                ("quantized_inference", bench_quantized_inference),
                ("resnet50_fusion", bench_resnet50_fusion),

@@ -87,6 +87,19 @@ Rules (waivable per line with ``# lint: disable=DLT00X`` or per file with
   (it defeats both the int8 path and the f32 serving dtype). Waivable
   inline like DLT003.
 
+- **DLT011 unseeded-global-rng-in-data-path**: in datasets/parallel code
+  paths, shuffle/sampling through MODULE-LEVEL RNG state
+  (``random.shuffle/sample/choice/random/randint/uniform``,
+  ``np.random.shuffle/permutation/choice/randint/random/rand/randn`` and
+  ``np.random.seed``) is the deterministic-epoch hazard: the data plane's
+  exactly-once resume and any-world bitwise epochs (datasets/sharded.py)
+  require every shuffle to be a pure function of ``(seed, epoch)``, and
+  global-state draws also race across the prefetch threads these paths
+  run on. Use a seeded instance — ``np.random.default_rng(seed)`` /
+  ``random.Random(seed)`` — instead; those are exempt by construction
+  (method calls on an instance, not the module). Waivable inline like
+  DLT003.
+
 Adding a rule: write a ``_rule_xxx(tree, src, path) -> List[LintViolation]``
 function and register it in ``_RULES``; tests in ``tests/test_lint.py``
 seed a fixture violating the rule and assert it fires.
@@ -741,6 +754,44 @@ def _rule_float_cast_in_quant(tree, src, path) -> List[LintViolation]:
     return out
 
 
+# ------------------------------------------------------------------ DLT011
+_GLOBAL_RNG_CALLS = {
+    "random.shuffle", "random.sample", "random.choice", "random.random",
+    "random.randint", "random.uniform",
+    "numpy.random.shuffle", "numpy.random.permutation",
+    "numpy.random.choice", "numpy.random.randint", "numpy.random.random",
+    "numpy.random.rand", "numpy.random.randn", "numpy.random.seed",
+}
+
+
+def _is_data_path(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return any(seg in p for seg in ("datasets/", "parallel/"))
+
+
+def _rule_unseeded_global_rng(tree, src, path) -> List[LintViolation]:
+    if not _is_data_path(path):
+        return []
+    aliases = _import_aliases(tree)
+    out: List[LintViolation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        q = _resolve(_dotted(node.func), aliases)
+        if q in _GLOBAL_RNG_CALLS:
+            out.append(LintViolation(
+                path, node.lineno, "DLT011",
+                f"'{q}(...)' draws from module-level RNG state in a "
+                "datasets/parallel path — a deterministic-epoch hazard: "
+                "fleet-true resume and any-world bitwise epochs need "
+                "every shuffle to be a pure function of (seed, epoch), "
+                "and global state also races across prefetch threads; "
+                "use a seeded np.random.default_rng(seed) / "
+                "random.Random(seed) instance (or waive inline for a "
+                "deliberately non-deterministic path)"))
+    return out
+
+
 # ----------------------------------------------------------------- harness
 _RULES = (
     _rule_module_level_jnp,
@@ -753,6 +804,7 @@ _RULES = (
     _rule_unbounded_queue,
     _rule_host_work_in_compression,
     _rule_float_cast_in_quant,
+    _rule_unseeded_global_rng,
 )
 
 

@@ -448,6 +448,11 @@ class CheckpointManager:
                     "file": name,
                     "sha256": hashlib.sha256(data).hexdigest(),
                     "size": len(data),
+                    # journaled block coverage: lets a selective restore
+                    # (sharded.fetch_blocks — the streaming reshard path)
+                    # decide which shard OBJECTS it needs without
+                    # fetching any payload
+                    "blocks": shd.shard_block_summary(data),
                 })
             entry = {
                 "file": f"{base}.sharded",
@@ -733,6 +738,27 @@ class CheckpointManager:
                             entry.get("file"), type(e).__name__, e)
         return None
 
+    def restore_blocks(self, want, filename: Optional[str] = None,
+                       trees=("coefficients", "updaterState")):
+        """Streaming reshard-on-restore: fetch only the blocks ``want``
+        selects from a SHARDED checkpoint (the newest one, or the named
+        journal entry), without reassembling the full state — see
+        ``checkpoint.sharded.fetch_blocks``. Per-host bytes read scale
+        with the host's share of the state instead of its whole size."""
+        from deeplearning4j_tpu.checkpoint import sharded as shd
+        if self._worker is not None and self._worker.is_alive():
+            self.flush()
+        entries = [e for e in self._restorable_entries() if e.get("sharded")]
+        if filename is not None:
+            entries = [e for e in entries if e.get("file") == filename]
+        if not entries:
+            raise CheckpointError(
+                "no sharded checkpoint entry"
+                + (f" named {filename!r}" if filename else "")
+                + " to fetch blocks from")
+        return shd.fetch_blocks(self._storage, entries[-1], want,
+                                trees=tuple(trees))
+
     def restore_entry(self, filename: str, load_updater: bool = True):
         """Restore one SPECIFIC committed checkpoint by its journal
         ``file`` name (sharded set entries use their virtual
@@ -809,7 +835,16 @@ def skip_consumed_batches(data, skip: int):
     contract requires replaying the interrupted run's data in the same
     order, and an exhausted one-shot generator or shorter dataset would
     otherwise silently train a no-op epoch and diverge from the
-    bitwise-resume guarantee."""
+    bitwise-resume guarantee.
+
+    SEEKABLE sources (``iter_from(start_batch)`` — datasets/sharded.py's
+    ShardedReader, incl. wrapped in AsyncDataSetIterator) skip by
+    seeking: the consumed batches are never fetched, sliced or ledgered
+    at all, which is what makes resume fleet-true — a restoring worker
+    at ANY world size jumps straight to the checkpoint's
+    ``batch_in_epoch`` cursor instead of replaying its way there."""
+    if skip and hasattr(data, "iter_from"):
+        return iter(data.iter_from(skip))
     it = iter(data)
     for i in range(skip):
         if next(it, _EXHAUSTED) is _EXHAUSTED:

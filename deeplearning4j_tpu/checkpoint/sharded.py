@@ -59,6 +59,7 @@ __all__ = [
     "ShardedCheckpointError", "shard_snapshot", "simulated_shard_snapshots",
     "shard_zip_bytes", "shard_object_name", "restore_from_payloads",
     "restore_sharded", "scan_shard_sets", "state_sha", "SHARD_PREFIX",
+    "shard_block_summary", "fetch_blocks",
 ]
 
 
@@ -381,6 +382,64 @@ def restore_from_payloads(payloads: List[bytes], load_updater: bool = True):
     model.iteration = int(meta.get("iteration", 0))
     model.epoch = int(meta.get("epoch", 0))
     return model, meta
+
+
+def shard_block_summary(payload: bytes) -> List[dict]:
+    """The (tree, leaf, index) coverage of one shard payload — journaled
+    per shard at save time so a selective restore can decide which shard
+    OBJECTS it needs without fetching any of them."""
+    return [{"tree": e["tree"], "leaf": e["leaf"], "index": e["index"]}
+            for e in _parse_shard(payload)["index"]]
+
+
+def fetch_blocks(storage, entry: dict, want,
+                 trees: Tuple[str, ...] = ("coefficients", "updaterState"),
+                 ) -> Dict[str, Dict[str, List[tuple]]]:
+    """Streaming reshard-on-restore: fetch ONLY the shard objects holding
+    blocks ``want`` selects, instead of reassembling the full state.
+
+    ``want(tree, leaf, index)`` (index = ((start, stop), ...) over the
+    leaf's global shape) returns whether the restoring host needs that
+    block — e.g. the row-range its NEW sharding assigns it. Shards whose
+    journaled block summary (written by ``CheckpointManager._save_sharded``)
+    contains no wanted block are never fetched, so per-host bytes read
+    shrink with the host's share of the state. Entries journaled before
+    block summaries existed fall back to fetching every shard (correct,
+    just not selective). Fetched shards are sha-verified like a full
+    restore.
+
+    Returns ``{tree: {leaf: [(index, array), ...]}}`` holding exactly the
+    wanted blocks. This is the block-level half of a streaming reshard:
+    full-model restores (DP-replicated params need every block anyway)
+    keep using :func:`restore_sharded`; tensor-parallel or
+    optimizer-sharded hosts pull their slice here and ``device_put`` it
+    straight into their new placement."""
+    fetched: Dict[str, Dict[str, List[tuple]]] = {t: {} for t in trees}
+    for s in entry.get("shards", []):
+        summary = s.get("blocks")
+        if summary is not None:
+            wanted = any(
+                b["tree"] in trees
+                and want(b["tree"], b["leaf"],
+                         tuple(tuple(p) for p in b["index"]))
+                for b in summary)
+            if not wanted:
+                continue
+        data = storage.get(s["file"])
+        if s.get("sha256") is not None and \
+                hashlib.sha256(data).hexdigest() != s["sha256"]:
+            raise ShardedCheckpointError(
+                f"checksum mismatch for shard {s['file']} (torn/corrupt)")
+        parsed = _parse_shard(data)
+        for ent in parsed["index"]:
+            if ent["tree"] not in trees:
+                continue
+            index = tuple(tuple(p) for p in ent["index"])
+            if not want(ent["tree"], ent["leaf"], index):
+                continue
+            fetched[ent["tree"]].setdefault(ent["leaf"], []).append(
+                (index, parsed["blocks"][ent["key"]]))
+    return fetched
 
 
 def restore_sharded(storage, entry: dict, load_updater: bool = True):

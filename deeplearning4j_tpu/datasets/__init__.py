@@ -27,6 +27,16 @@ from deeplearning4j_tpu.datasets.streaming import (  # noqa: F401
     StreamingDataSetIterator,
     StreamingHttpReceiver,
 )
+from deeplearning4j_tpu.datasets.sharded import (  # noqa: F401
+    DataLeaseError,
+    DataLeaseTimeout,
+    StaleDataLeaseError,
+    ShardedDataset,
+    ShardedReader,
+    ShardLeaseBoard,
+    LedgerReport,
+    reconcile_ledger,
+)
 from deeplearning4j_tpu.datasets.records import (  # noqa: F401
     RecordReader,
     CollectionRecordReader,

@@ -204,6 +204,34 @@ class AsyncDataSetIterator(DataSetIterator):
     def total_outcomes(self):
         return self._base.total_outcomes()
 
+    # seekable/epoch-aware base (datasets/sharded.py ShardedReader):
+    # forward the resume/seek surface so exact-step resume still seeks
+    # without materializing when the reader is wrapped for prefetch.
+    # Via __getattr__ (not plain methods) so hasattr() on the wrapper
+    # reflects whether the BASE actually supports seeking.
+    def __getattr__(self, name):
+        if name == "bind_epoch":
+            base_bind = getattr(self._base, name)  # AttributeError if not
+
+            def bind_epoch(provider):
+                base_bind(provider)
+                return self
+            return bind_epoch
+        if name == "iter_from":
+            base_iter_from = getattr(self._base, name)
+
+            def iter_from(start_batch):
+                gen = _async_generate(base_iter_from(start_batch),
+                                      self._queue_size, self._END)
+                # a pre_processor set on THIS wrapper must apply on the
+                # seek path exactly as __iter__ applies it
+                if self.pre_processor is None:
+                    return gen
+                return (self.pre_processor(ds) for ds in gen)
+            return iter_from
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}")
+
 
 class EarlyTerminationDataSetIterator(DataSetIterator):
     """Cap the number of minibatches (reference

@@ -530,6 +530,10 @@ class ComputationGraph:
         from deeplearning4j_tpu.checkpoint.manager import (
             resume_plan, skip_consumed_batches)
         epochs_to_run, skip = resume_plan(self, num_epochs)
+        if hasattr(data, "bind_epoch"):
+            # epoch-aware sharded readers follow the model's epoch
+            # counter (see multilayer.py fit)
+            data.bind_epoch(lambda: self.epoch)
         step = self._get_jitted("train")
         from deeplearning4j_tpu.obs.trace import get_tracer
         tracer = get_tracer()

@@ -297,6 +297,54 @@ class MultiLayerNetwork:
         models, high-latency links) this is the throughput path; see
         ``fit_fused``."""
         value_and_grad = jax.value_and_grad(self._loss_fn, has_aux=True)
+        comp = self.grad_compression
+        if comp is not None:
+            # compressed collectives on the fused path: cstate (error-
+            # feedback residual + controller) threads through the scan
+            # carry exactly like opt_state, so K fused steps evolve the
+            # residual identically to K per-batch fit() calls
+            def fused_c(params, state, opt_state, cstate, rng, xs, ys,
+                        fmasks, lmasks):
+                def body(carry, inp):
+                    params, state, opt_state, cstate, rng = carry
+                    x, y, fm, lm = inp
+                    rng, k = jax.random.split(rng)   # same chain as fit()
+                    (loss, new_state), grads = value_and_grad(
+                        params, state, x, y, k, fm, lm)
+                    grads, cstate = comp.apply(grads, cstate)
+                    new_params, new_opt = self._apply_updates(
+                        params, grads, opt_state)
+                    return (new_params, new_state, new_opt, cstate,
+                            rng), loss
+
+                (params, state, opt_state, cstate, rng), losses = \
+                    jax.lax.scan(body,
+                                 (params, state, opt_state, cstate, rng),
+                                 (xs, ys, fmasks, lmasks))
+                return params, state, opt_state, cstate, rng, losses
+
+            def fused_c_nomask(params, state, opt_state, cstate, rng, xs,
+                               ys):
+                def body(carry, inp):
+                    params, state, opt_state, cstate, rng = carry
+                    x, y = inp
+                    rng, k = jax.random.split(rng)
+                    (loss, new_state), grads = value_and_grad(
+                        params, state, x, y, k, None, None)
+                    grads, cstate = comp.apply(grads, cstate)
+                    new_params, new_opt = self._apply_updates(
+                        params, grads, opt_state)
+                    return (new_params, new_state, new_opt, cstate,
+                            rng), loss
+
+                (params, state, opt_state, cstate, rng), losses = \
+                    jax.lax.scan(body,
+                                 (params, state, opt_state, cstate, rng),
+                                 (xs, ys))
+                return params, state, opt_state, cstate, rng, losses
+
+            return (jax.jit(fused_c, donate_argnums=(0, 1, 2, 3)),
+                    jax.jit(fused_c_nomask, donate_argnums=(0, 1, 2, 3)))
 
         def fused(params, state, opt_state, rng, xs, ys, fmasks, lmasks):
             def body(carry, inp):
@@ -360,11 +408,6 @@ class MultiLayerNetwork:
         if self.conf.backprop_type == "tbptt":
             raise ValueError("fit_fused does not window tBPTT sequences; "
                              "use fit() for tbptt-configured networks")
-        if self.grad_compression is not None:
-            raise ValueError(
-                "fit_fused does not support grad_compression: the "
-                "compressed collective is wired into the per-batch jitted "
-                "step — train through fit() (or ParallelWrapper.fit)")
         fmasks = lmasks = None
         if isinstance(datasets, tuple) and len(datasets) == 2:
             xa, ya = datasets
@@ -411,7 +454,24 @@ class MultiLayerNetwork:
             fmasks = _stack_masks([d.features_mask for d in datasets])
             lmasks = _stack_masks([d.labels_mask for d in datasets])
         step_masked, step_nomask = self._get_jitted("train_fused")
-        if fmasks is not None or lmasks is not None:
+        if self.grad_compression is not None:
+            # compressed fused steps thread cstate through the scan carry
+            # (same error-feedback evolution as K per-batch fit() calls)
+            if self.compress_state is None:
+                from deeplearning4j_tpu.parallel.compress import (
+                    ensure_compress_state)
+                ensure_compress_state(self)
+            if fmasks is not None or lmasks is not None:
+                (self.params, self.state, self.opt_state,
+                 self.compress_state, self._rng, losses) = step_masked(
+                    self.params, self.state, self.opt_state,
+                    self.compress_state, self._rng, xs, ys, fmasks, lmasks)
+            else:
+                (self.params, self.state, self.opt_state,
+                 self.compress_state, self._rng, losses) = step_nomask(
+                    self.params, self.state, self.opt_state,
+                    self.compress_state, self._rng, xs, ys)
+        elif fmasks is not None or lmasks is not None:
             self.params, self.state, self.opt_state, self._rng, losses = \
                 step_masked(self.params, self.state, self.opt_state,
                             self._rng, xs, ys, fmasks, lmasks)
@@ -664,6 +724,11 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.checkpoint.manager import (
             resume_plan, skip_consumed_batches)
         epochs_to_run, skip = resume_plan(self, num_epochs)
+        if hasattr(data, "bind_epoch"):
+            # epoch-aware sharded readers (datasets/sharded.py) follow
+            # the MODEL's epoch counter, so a restored model replays
+            # exactly the interrupted epoch's shuffle order
+            data.bind_epoch(lambda: self.epoch)
         if not is_sgd_family(self.conf):
             # full-batch solver path (reference Solver.java dispatch on
             # OptimizationAlgorithm — LBFGS / CG / line gradient descent)
@@ -794,6 +859,32 @@ class MultiLayerNetwork:
         value_and_grad, and the carries passed forward are values, not
         differentiated across windows. Same rng split chain as _fit_tbptt."""
         value_and_grad = jax.value_and_grad(self._loss_fn_tbptt, has_aux=True)
+        comp = self.grad_compression
+        if comp is not None:
+            # cstate through the scan carry — per-window error-feedback
+            # evolution identical to the per-window _fit_tbptt loop
+            def fused_c(params, state, opt_state, cstate, carries, rng,
+                        xw, yw):
+                def body(c, inp):
+                    params, state, opt_state, cstate, carries, rng = c
+                    x, y = inp
+                    rng, k = jax.random.split(rng)
+                    (loss, (new_state, new_carries)), grads = \
+                        value_and_grad(params, state, carries, x, y, k,
+                                       None, None)
+                    grads, cstate = comp.apply(grads, cstate)
+                    new_params, new_opt = self._apply_updates(
+                        params, grads, opt_state)
+                    return (new_params, new_state, new_opt, cstate,
+                            new_carries, rng), loss
+
+                (params, state, opt_state, cstate, carries, rng), losses = \
+                    jax.lax.scan(body, (params, state, opt_state, cstate,
+                                        carries, rng), (xw, yw))
+                return (params, state, opt_state, cstate, carries, rng,
+                        losses)
+
+            return jax.jit(fused_c, donate_argnums=(0, 1, 2, 3, 4))
 
         def fused(params, state, opt_state, carries, rng, xw, yw):
             def body(c, inp):
@@ -825,11 +916,6 @@ class MultiLayerNetwork:
         if self.conf.backprop_type != "tbptt":
             raise ValueError("fit_tbptt_fused requires backprop_type='tbptt' "
                              "(this network is 'standard'; use fit/fit_fused)")
-        if self.grad_compression is not None:
-            raise ValueError(
-                "fit_tbptt_fused does not support grad_compression: the "
-                "compressed collective is wired into the per-window jitted "
-                "step — train through fit()")
         x = jnp.asarray(x)
         y = jnp.asarray(y)
         L = self.conf.tbptt_fwd_length
@@ -845,9 +931,19 @@ class MultiLayerNetwork:
               if y.ndim == 3 else jnp.broadcast_to(y, (w,) + y.shape))
         carries = self._zero_carries(b)
         step = self._get_jitted("tbptt_fused")
-        (self.params, self.state, self.opt_state, _, self._rng,
-         losses) = step(self.params, self.state, self.opt_state, carries,
-                        self._rng, xw, yw)
+        if self.grad_compression is not None:
+            if self.compress_state is None:
+                from deeplearning4j_tpu.parallel.compress import (
+                    ensure_compress_state)
+                ensure_compress_state(self)
+            (self.params, self.state, self.opt_state, self.compress_state,
+             _, self._rng, losses) = step(
+                self.params, self.state, self.opt_state,
+                self.compress_state, carries, self._rng, xw, yw)
+        else:
+            (self.params, self.state, self.opt_state, _, self._rng,
+             losses) = step(self.params, self.state, self.opt_state,
+                            carries, self._rng, xw, yw)
         self._score = losses[-1]
         self.last_batch_size = b
         self._last_features = x[:1]

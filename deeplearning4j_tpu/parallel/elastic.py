@@ -772,7 +772,19 @@ class ElasticWorker:
             return local_change
         return "peer detected a membership change" if n > 0 else None
 
-    def _data_for(self, data, rank: int, world: int):
+    def _data_for(self, data, rank: int, world: int,
+                  membership: Optional[Membership] = None):
+        if hasattr(data, "reader") and hasattr(data, "epoch_order"):
+            # a datasets/sharded.py ShardedDataset: every generation gets
+            # a fresh reader for its (rank, world) slice, claiming
+            # record-range leases under THIS worker's id and the current
+            # membership generation (the data-plane half of the
+            # split-brain fence — a stale generation's reader raises
+            # StaleDataLeaseError instead of consuming ranges the live
+            # fleet owns)
+            return data.reader(
+                rank, world, worker_id=self.worker_id,
+                generation=membership.generation if membership else 0)
         if callable(data):
             return data(rank, world)
         if world <= 1:
@@ -855,6 +867,7 @@ class ElasticWorker:
                             f"{e})") from e
                     want = m.generation + 1
                     continue
+                local = None
                 try:
                     # re-read the journal from storage: in-process
                     # survivors only APPEND entries locally on the host
@@ -873,7 +886,7 @@ class ElasticWorker:
                         # different fresh model
                         self.cm.save(model)
                     trainer = ClusterTrainer(model)
-                    local = self._data_for(data, rank, world)
+                    local = self._data_for(data, rank, world, m)
                     if self.on_generation is not None:
                         self.on_generation(model, m, rank, world)
                     if m.generation > 1:
@@ -898,7 +911,13 @@ class ElasticWorker:
                         trainer.fit_local_shard(
                             local, num_epochs=target,
                             collective_timeout_s=self.collective_timeout_s,
-                            watchdog_every=1)
+                            watchdog_every=1,
+                            # step-cadence triggers (save_every_n_steps on
+                            # the manager) commit MID-epoch sharded
+                            # checkpoints — with a seekable sharded reader
+                            # a kill-and-resume then replays ZERO consumed
+                            # batches even across an N→M reshard
+                            checkpoint_manager=self.cm)
                         consecutive = 0
                         self.cm.save(model)
                         rec.epochs += 1
@@ -968,6 +987,15 @@ class ElasticWorker:
                         raise
                 finally:
                     rec.wall_s = time.monotonic() - t0
+                    if local is not None and hasattr(local, "release_all"):
+                        # drop this generation's record-range leases so
+                        # the next generation's readers don't wait a TTL
+                        # on ranges we will never consume
+                        try:
+                            local.release_all()
+                        except Exception as le:
+                            log.warning("data-lease release failed "
+                                        "(%s: %s)", type(le).__name__, le)
                     self._obs_event("elastic.generation_end",
                                     generation=m.generation,
                                     epochs=rec.epochs, reason=rec.ended)

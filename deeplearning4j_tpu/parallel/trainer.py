@@ -267,6 +267,10 @@ class ParallelWrapper:
         from deeplearning4j_tpu.checkpoint.manager import (
             resume_plan, skip_consumed_batches)
         epochs_to_run, skip = resume_plan(self.model, num_epochs)
+        if hasattr(data, "bind_epoch"):
+            # epoch-aware sharded readers follow the model's epoch
+            # counter (see multilayer.py fit)
+            data.bind_epoch(lambda: self.model.epoch)
         for _ in range(epochs_to_run):
             for listener in self.model.listeners:
                 listener.on_epoch_start(self.model)
@@ -521,6 +525,11 @@ class ClusterTrainer(ParallelWrapper):
         from deeplearning4j_tpu.obs.trace import get_tracer
         tracer = get_tracer()
         epochs_to_run, skip = resume_plan(self.model, num_epochs)
+        if hasattr(data, "bind_epoch"):
+            # epoch-aware sharded readers follow the model's epoch
+            # counter — fleet-true resume replays the interrupted
+            # epoch's shuffle order at ANY world size
+            data.bind_epoch(lambda: self.model.epoch)
         step_no = 0
         with self.mesh:
             for _ in range(epochs_to_run):

@@ -101,9 +101,9 @@ class DevicePrefetchIterator(DataSetIterator):
                        self._place_array(ds.labels_mask))
 
     # ------------------------------------------------------------- iteration
-    def _generate(self):
+    def _pump(self, source):
         buf: deque = deque()
-        for ds in self._base:
+        for ds in source:
             # the base applies its OWN preprocessor while iterating; one set
             # on this wrapper must also run — before device placement
             if self.pre_processor is not None:
@@ -114,10 +114,34 @@ class DevicePrefetchIterator(DataSetIterator):
         while buf:
             yield buf.popleft()
 
+    def _generate(self):
+        return self._pump(self._base)
+
     def __iter__(self):
         # bypass DataSetIterator.__iter__'s reset plumbing: iterating the
-        # base runs its own reset (the preprocessor is handled in _generate)
+        # base runs its own reset (the preprocessor is handled in _pump)
         return self._generate()
+
+    # seekable/epoch-aware base (datasets/sharded.py ShardedReader,
+    # possibly under AsyncDataSetIterator): forward the resume/seek
+    # surface so fleet-true resume survives the device-prefetch wrapper.
+    # Via __getattr__ so hasattr() reflects whether the BASE supports it.
+    def __getattr__(self, name):
+        if name == "bind_epoch":
+            base_bind = getattr(self._base, name)  # AttributeError if not
+
+            def bind_epoch(provider):
+                base_bind(provider)
+                return self
+            return bind_epoch
+        if name == "iter_from":
+            base_iter_from = getattr(self._base, name)
+
+            def iter_from(start_batch):
+                return self._pump(base_iter_from(start_batch))
+            return iter_from
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}")
 
     def reset(self):
         if hasattr(self._base, "reset"):

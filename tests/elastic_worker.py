@@ -89,6 +89,44 @@ def _global_batches():
     return DataSet(x, y).split(batch)
 
 
+def _sharded_dataset(wid):
+    """CFG['data_plane'] mode: the lease-based sharded data plane
+    (datasets/sharded.py) over the same deterministic records —
+    ElasticWorker builds a per-generation reader from it. The optional
+    fetch-time kill (``kill_at_fetch: {wid: {epoch, batch}}``) SIGKILLs
+    THIS worker when its reader is asked for that global batch — a
+    preemption landing between steps, the exactly-once acceptance shape."""
+    from deeplearning4j_tpu.checkpoint import LocalFSBackend
+    from deeplearning4j_tpu.datasets.sharded import ShardedDataset
+    dp = CFG["data_plane"]
+    rng = np.random.default_rng(int(CFG.get("data_seed", 0)))
+    n, batch = int(CFG.get("n_rows", 48)), int(CFG.get("batch", 24))
+    x = rng.random((n, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    sds = ShardedDataset(
+        x, y, batch_size=batch, seed=int(dp.get("seed", 9)),
+        store=LocalFSBackend(os.path.join(CFG["store_dir"], "data")),
+        ledger=bool(dp.get("ledger", True)),
+        lease_ttl_s=float(CFG.get("lease_ttl_s", 3.0)),
+        lease_batches=int(dp.get("lease_batches", 2)))
+    kill = (dp.get("kill_at_fetch") or {}).get(wid)
+    if kill and not (kill.get("first_attempt_only") and _ATTEMPT > 1):
+        target = (int(kill["epoch"]), int(kill["batch"]))
+        def fetch_hook(epoch, batch_idx):
+            if (epoch, batch_idx) == target:
+                from deeplearning4j_tpu.obs.flight import (
+                    flush_flight_recorder)
+                try:
+                    flush_flight_recorder(
+                        f"data-plane kill at fetch e{epoch} b{batch_idx}")
+                except Exception:
+                    pass
+                import signal
+                os.kill(os.getpid(), signal.SIGKILL)
+        sds.fetch_hook = fetch_hook
+    return sds
+
+
 def main():
     wid = _WORKER_ID
     out_dir = CFG["out_dir"]
@@ -120,6 +158,7 @@ def main():
     cm = CheckpointManager(
         storage=LocalFSBackend(os.path.join(CFG["store_dir"], "ckpt")),
         sharded=True, async_write=False,
+        save_every_n_steps=CFG.get("save_every_n_steps"),
         barrier_timeout_s=float(CFG.get("barrier_timeout_s", 10.0)))
 
     kill = (CFG.get("kill") or {}).get(wid)
@@ -169,8 +208,10 @@ def main():
         init_timeout_s=int(CFG.get("init_timeout_s", 30)),
         on_generation=on_generation)
 
+    data = (_sharded_dataset(wid) if CFG.get("data_plane")
+            else _global_batches())
     try:
-        summary = worker.run(_model_factory, _global_batches(),
+        summary = worker.run(_model_factory, data,
                              num_epochs=int(CFG["num_epochs"]))
     except ElasticRestartRequired as e:
         print(f"{wid}: elastic restart required: {e}", flush=True)

@@ -278,13 +278,118 @@ class TestGuards:
         with pytest.raises(ValueError, match="already has"):
             enable_grad_compression(net, OneBitCompression())
 
-    def test_fused_paths_raise(self):
-        net = _net()
-        enable_grad_compression(net, Int8Compression())
-        x = np.zeros((2, 4, 4), np.float32)
-        y = np.zeros((2, 4, 3), np.float32)
-        with pytest.raises(ValueError, match="fit_fused"):
-            net.fit_fused((jnp.asarray(x), jnp.asarray(y)))
+    def test_solver_fused_still_guarded(self):
+        # the SGD-family guard on fit_fused is unchanged by compression
+        conf = (NeuralNetConfiguration.builder()
+                .seed(7).updater(Sgd(learning_rate=0.05))
+                .weight_init("xavier").list()
+                .optimization_algo("lbfgs")
+                .layer(DenseLayer(n_out=16, activation="tanh"))
+                .layer(OutputLayer(n_out=3, loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        with pytest.raises(ValueError, match="SGD-family"):
+            net.fit_fused((jnp.zeros((2, 4, 4)), jnp.zeros((2, 4, 3))))
+
+
+# ============================================ fused-path compression parity
+class TestFusedCompression:
+    """ISSUE 11 satellite (PR 9 leftover): cstate threads through the
+    lax.scan carry, so the fused multi-batch paths accept
+    grad_compression and match the unfused compressed step BITWISE."""
+
+    def _batches(self, k=4, b=12, seed=0):
+        rng = np.random.default_rng(seed)
+        xs = rng.random((k, b, 4)).astype(np.float32)
+        ys = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (k, b))]
+        return [DataSet(xs[i], ys[i]) for i in range(k)]
+
+    @pytest.mark.parametrize("scheme", ALL_SCHEMES,
+                             ids=lambda s: type(s).__name__)
+    def test_fit_fused_matches_per_batch_bitwise(self, scheme):
+        seq = _net()
+        enable_grad_compression(seq, scheme)
+        fused = seq.clone()
+        batches = self._batches()
+        for ds in batches:
+            seq.fit(ds)
+        fused.fit_fused(batches)
+        assert fused.iteration == seq.iteration == len(batches)
+        assert fused.compress_state is not None
+        for a, b in zip(
+                jax.tree_util.tree_leaves(
+                    [seq.params, seq.opt_state, seq.compress_state]),
+                jax.tree_util.tree_leaves(
+                    [fused.params, fused.opt_state, fused.compress_state])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_fit_fused_masked_group_compresses(self):
+        # masked variant: the compressed masked scan runs and evolves the
+        # residual exactly like the per-batch masked step
+        from deeplearning4j_tpu.nn.conf.recurrent import (LSTM,
+                                                          RnnOutputLayer)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(3).updater(Sgd(learning_rate=0.05))
+                .weight_init("xavier").list()
+                .layer(LSTM(n_out=6, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(3)).build())
+        seq = MultiLayerNetwork(conf).init()
+        enable_grad_compression(
+            seq, ThresholdCompression(target_sparsity=0.1))
+        fused = seq.clone()
+        rng = np.random.default_rng(3)
+        batches = []
+        for _ in range(3):
+            x = rng.standard_normal((4, 6, 3)).astype(np.float32)
+            y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (4, 6))]
+            m = np.zeros((4, 6), np.float32)
+            m[:, :4] = 1.0
+            batches.append(DataSet(x, y, features_mask=m, labels_mask=m))
+        for ds in batches:
+            seq.fit(ds)
+        fused.fit_fused(batches)
+        for a, b in zip(
+                jax.tree_util.tree_leaves(
+                    [seq.params, seq.compress_state]),
+                jax.tree_util.tree_leaves(
+                    [fused.params, fused.compress_state])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_fit_tbptt_fused_matches_per_window_bitwise(self):
+        from deeplearning4j_tpu.nn.conf.recurrent import (LSTM,
+                                                          RnnOutputLayer)
+
+        def make():
+            conf = (NeuralNetConfiguration.builder()
+                    .seed(21).updater(Sgd(learning_rate=0.05))
+                    .weight_init("xavier").list()
+                    .layer(LSTM(n_out=8, activation="tanh"))
+                    .layer(RnnOutputLayer(n_out=3, loss="mcxent"))
+                    .set_input_type(InputType.recurrent(4))
+                    .backprop_type("tbptt", fwd_length=5, back_length=5)
+                    .build())
+            net = MultiLayerNetwork(conf).init()
+            enable_grad_compression(
+                net, ThresholdCompression(target_sparsity=0.1))
+            return net
+
+        rng = np.random.default_rng(5)
+        x = rng.random((3, 10, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (3, 10))]
+        seq = make()
+        fused = seq.clone()
+        seq.fit(DataSet(x, y))          # 2 windows via the per-window loop
+        fused.fit_tbptt_fused(x, y)     # same 2 windows, one dispatch
+        assert fused.iteration == seq.iteration == 2
+        for a, b in zip(
+                jax.tree_util.tree_leaves(
+                    [seq.params, seq.opt_state, seq.compress_state]),
+                jax.tree_util.tree_leaves(
+                    [fused.params, fused.opt_state, fused.compress_state])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 # ====================================== convergence parity + determinism

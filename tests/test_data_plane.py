@@ -1,0 +1,219 @@
+"""Streaming data plane chaos acceptance (ISSUE 11 tentpole).
+
+Multi-process fleets of tests/elastic_worker.py in ``data_plane`` mode:
+the elastic workers train from a lease-based :class:`ShardedDataset`
+with the per-record consumption ledger on and MID-epoch step-cadence
+sharded checkpoints (``save_every_n_steps=1``), and a victim is
+SIGKILLed at data-FETCH time mid-epoch — the between-steps preemption
+shape. The headline asserts the fleet-true exactly-once story end to
+end: a 4→3 reshard resumes at the exact global batch cursor with zero
+consumed batches replayed and zero records dropped or duplicated
+(ledger-reconciled), every epoch's record order equal to the
+world-independent plan; the same-world variant additionally proves the
+mid-epoch resume is BITWISE-identical to the uninterrupted run.
+
+All fleet tests are ``slow``-marked (tier-1 never waits on them) and
+run under ``train_until_process``'s hard overall deadline, the
+test_resilience.py discipline. The in-process halves of the acceptance
+(world 1/2/4 identical orders, seek-resume, lease chaos) are tier-1 in
+tests/test_datapipeline.py and tests/test_elastic.py.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ELASTIC_WORKER = os.path.join(_HERE, "elastic_worker.py")
+
+
+def _cfg(tmp_path, **overrides):
+    cfg = {
+        "store_dir": str(tmp_path / "store"),
+        "out_dir": str(tmp_path / "out"),
+        "num_workers": 4, "devices_per_worker": 2, "num_epochs": 4,
+        "n_rows": 48, "batch": 24,
+        "lease_ttl_s": 3.0, "collective_timeout_s": 8.0,
+        "barrier_timeout_s": 8.0, "scaledown_grace_s": 4.0,
+        "join_timeout_s": 45.0, "poll_s": 0.15,
+        "save_every_n_steps": 1,
+        "data_plane": {"seed": 9, "ledger": True, "lease_batches": 2},
+    }
+    cfg.update(overrides)
+    os.makedirs(cfg["out_dir"], exist_ok=True)
+    path = str(tmp_path / "data-plane-cfg.json")
+    with open(path, "w") as f:
+        json.dump(cfg, f)
+    return path, cfg
+
+
+def _env():
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _run_fleet(cfg_path, worker_ids, timeout, respawn_preempted,
+               max_restarts=8, log_dir=None):
+    """Supervised fleet with a HARD overall deadline — the supervisor
+    kills every child on expiry, so this can never outlive ``timeout``."""
+    from deeplearning4j_tpu.checkpoint.resume import RestartPolicy
+    from deeplearning4j_tpu.checkpoint.supervisor import train_until_process
+    return train_until_process(
+        lambda i, attempt: [sys.executable, _ELASTIC_WORKER, cfg_path,
+                            worker_ids[i], str(attempt)],
+        num_workers=len(worker_ids),
+        restart_policy=RestartPolicy(max_restarts=max_restarts,
+                                     backoff_s=0.2, max_backoff_s=1.0),
+        respawn_preempted=respawn_preempted,
+        attempt_timeout_s=timeout, overall_timeout_s=timeout,
+        env=_env(), log_dir=log_dir)
+
+
+def _out_json(cfg, name):
+    with open(os.path.join(cfg["out_dir"], name)) as f:
+        return json.load(f)
+
+
+def _plan_for(cfg):
+    """The world-independent shuffle plan the fleet should have followed
+    — rebuilt in THIS process from the same config."""
+    from deeplearning4j_tpu.datasets.sharded import ShardedDataset
+    rng = np.random.default_rng(int(cfg.get("data_seed", 0)))
+    n, batch = int(cfg["n_rows"]), int(cfg["batch"])
+    x = rng.random((n, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return ShardedDataset(x, y, batch_size=batch,
+                          seed=int(cfg["data_plane"]["seed"]))
+
+
+def _assert_ledger_fleet_true(cfg, num_epochs):
+    """The exactly-once core: reconcile the fleet's consumption ledger
+    and assert (a) no record duplicated or dropped, (b) every epoch's
+    authoritative record order equals the world-independent plan, and
+    (c) ZERO consumed batches were replayed — the committed
+    ``batch_in_epoch`` cursor in the checkpoint journal is strictly
+    increasing within every epoch, so no committed batch was ever
+    re-trained."""
+    from deeplearning4j_tpu.checkpoint import (CheckpointManager,
+                                               LocalFSBackend)
+    from deeplearning4j_tpu.datasets.sharded import reconcile_ledger
+    plan = _plan_for(cfg)
+    report = reconcile_ledger(
+        LocalFSBackend(os.path.join(cfg["store_dir"], "data")),
+        batch_size=int(cfg["batch"]))
+    assert report.clean, (report.duplicates, report.gaps)
+    assert sorted(report.epochs) == list(range(num_epochs))
+    for e in range(num_epochs):
+        assert report.epochs[e] == plan.epoch_order(e).tolist(), \
+            f"epoch {e} record order diverged from the plan"
+    cm = CheckpointManager(
+        storage=LocalFSBackend(os.path.join(cfg["store_dir"], "ckpt")))
+    by_epoch = {}
+    for entry in cm.checkpoints():  # journal keeps append order via seq
+        by_epoch.setdefault(int(entry["epoch"]), []).append(
+            int(entry["batch_in_epoch"]))
+    for epoch, cursors in by_epoch.items():
+        assert cursors == sorted(set(cursors)), (
+            f"epoch {epoch} committed cursors {cursors} regressed or "
+            "repeated — a CONSUMED batch was replayed")
+    cm.close()
+    return report, cm
+
+
+@pytest.mark.slow
+def test_data_plane_4to3_sigkill_midepoch_exactly_once(tmp_path):
+    """HEADLINE acceptance: a 4-worker fleet trains from the sharded
+    lease-based data plane; w02 is SIGKILLed at data-fetch time
+    mid-epoch (epoch 1, global batch 1). Survivors re-shard 4→3 and
+    finish all epochs; the consumption ledger reconciles to exactly the
+    planned (world-independent) record order for EVERY epoch with no
+    record seen twice and none dropped, zero consumed batches are
+    replayed (strictly-increasing committed cursors), only the one
+    in-flight batch is contested (rolled back, re-consumed by the next
+    generation), survivors agree bitwise, and the final sharded
+    checkpoint restores HERE to the survivors' digest."""
+    cfg_path, cfg = _cfg(tmp_path)
+    cfg["data_plane"]["kill_at_fetch"] = {
+        "w02": {"epoch": 1, "batch": 1}}
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+    ids = [f"w{i:02d}" for i in range(4)]
+    s = _run_fleet(cfg_path, ids, timeout=360, respawn_preempted=False,
+                   log_dir=str(tmp_path / "logs"))
+    assert s.completed
+    preempted = {c.worker for c in s.crashes if c.error_type == "Preempted"}
+    assert preempted == {2}    # the victim really died by SIGKILL
+    done = [_out_json(cfg, f"done-w{i:02d}.json") for i in (0, 1, 3)]
+    assert all(d["epochs"] == cfg["num_epochs"] for d in done)
+    assert len({d["state_sha"] for d in done}) == 1
+    worlds = [g["world"] for d in done for g in d["generations"]]
+    assert max(worlds) == 4 and min(worlds) == 3   # a genuine 4→3
+    report, _ = _assert_ledger_fleet_true(cfg, cfg["num_epochs"])
+    # the ONLY contested slot is the in-flight batch the kill rolled
+    # back: epoch 1 batch 1, first trained (never committed) by the
+    # world-4 generation, re-consumed by the world-3 one
+    assert [(e, b) for e, b, _gens in report.contested] == [(1, 1)]
+    from deeplearning4j_tpu.checkpoint import (CheckpointManager,
+                                               LocalFSBackend, state_sha)
+    cm = CheckpointManager(
+        storage=LocalFSBackend(os.path.join(cfg["store_dir"], "ckpt")))
+    final = cm.restore_latest()
+    assert state_sha(final) == done[0]["state_sha"]
+    assert final.epoch == cfg["num_epochs"]
+    cm.close()
+
+
+@pytest.mark.slow
+def test_data_plane_whole_fleet_kill_midepoch_bitwise(tmp_path):
+    """Same-world mid-epoch preemption is BITWISE: both workers of a
+    2-worker fleet are SIGKILLed at data-fetch time mid-epoch, the
+    supervisor respawns them, the world re-forms at the same size and
+    resumes at the exact global batch cursor (seek, zero replay) — the
+    final state is bitwise-identical to the uninterrupted fleet's, and
+    the ledger has NO contested batch at all (nothing was in flight:
+    the kill landed before the batch was handed to training)."""
+    ids = ["w00", "w01"]
+    base = dict(num_workers=2, num_epochs=3, scaledown_grace_s=12.0,
+                join_timeout_s=60.0)
+    clean_path, clean_cfg = _cfg(tmp_path / "clean", **base)
+    s = _run_fleet(clean_path, ids, timeout=300, respawn_preempted=True,
+                   log_dir=str(tmp_path / "clean-logs"))
+    assert s.completed and s.restarts == 0
+    _assert_ledger_fleet_true(clean_cfg, base["num_epochs"])
+
+    kill_path, kill_cfg = _cfg(tmp_path / "killed", **base)
+    kill_cfg["data_plane"]["kill_at_fetch"] = {
+        "w00": {"epoch": 1, "batch": 1, "first_attempt_only": True},
+        "w01": {"epoch": 1, "batch": 1, "first_attempt_only": True}}
+    with open(kill_path, "w") as f:
+        json.dump(kill_cfg, f)
+    s2 = _run_fleet(kill_path, ids, timeout=300, respawn_preempted=True,
+                    log_dir=str(tmp_path / "killed-logs"))
+    assert s2.completed and s2.restarts >= 1   # the fleet really died
+    report, _ = _assert_ledger_fleet_true(kill_cfg, base["num_epochs"])
+    assert report.contested == []   # killed at fetch: nothing in flight
+    for wid in ids:
+        a = _out_json(clean_cfg, f"done-{wid}.json")
+        b = _out_json(kill_cfg, f"done-{wid}.json")
+        assert a["epochs"] == b["epochs"] == base["num_epochs"]
+        assert a["state_sha"] == b["state_sha"], \
+            "mid-epoch same-world resume diverged from the " \
+            "uninterrupted run"
+
+
+def test_data_plane_fleet_tests_are_slow_marked_and_bounded():
+    """Tier-1 guard (test_resilience.py precedent): the multi-process
+    data-plane tests can never hang tier-1 — each is ``slow``-marked and
+    every fleet run goes through the supervisor's hard overall
+    deadline."""
+    import inspect
+    for t in (test_data_plane_4to3_sigkill_midepoch_exactly_once,
+              test_data_plane_whole_fleet_kill_midepoch_bitwise):
+        marks = [m.name for m in getattr(t, "pytestmark", [])]
+        assert "slow" in marks, t.__name__
+    sup = inspect.getsource(_run_fleet)
+    assert "overall_timeout_s=timeout" in sup

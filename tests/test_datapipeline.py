@@ -458,3 +458,344 @@ def test_streaming_http_receiver():
         assert batches[0].labels.shape == (4, 2)
     finally:
         recv.stop()
+
+
+# ===================================================== sharded data plane
+# datasets/sharded.py (ISSUE 11 tentpole): deterministic distributed
+# shuffle, record-range leases, seekable exactly-once resume, and the
+# per-record consumption ledger. The multi-process 4→3 SIGKILL acceptance
+# lives in tests/test_data_plane.py (slow); everything here is in-process
+# tier-1 coverage of the same machinery.
+
+def _dp_records(n=48, width=4, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.random((n, width)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+    return x, y
+
+
+class TestShardedPlan:
+    def test_epoch_order_identical_at_any_world(self):
+        from deeplearning4j_tpu.datasets.sharded import ShardedDataset
+        x, y = _dp_records()
+        sds = ShardedDataset(x, y, batch_size=24, seed=7)
+        stacked = {}
+        for world in (1, 2, 4):
+            readers = [iter(sds.reader(r, world).bind_epoch(lambda: 0))
+                       for r in range(world)]
+            batches = []
+            for _ in range(sds.num_batches):
+                parts = [next(it) for it in readers]
+                batches.append(np.concatenate([p.features for p in parts]))
+            stacked[world] = np.stack(batches)
+        np.testing.assert_array_equal(stacked[1], stacked[2])
+        np.testing.assert_array_equal(stacked[1], stacked[4])
+
+    def test_epoch_orders_shuffle_and_replay(self):
+        from deeplearning4j_tpu.datasets.sharded import ShardedDataset
+        x, y = _dp_records()
+        sds = ShardedDataset(x, y, batch_size=12, seed=7)
+        o0, o1 = sds.epoch_order(0), sds.epoch_order(1)
+        assert not np.array_equal(o0, o1)           # epochs reshuffle
+        np.testing.assert_array_equal(o0, sds.epoch_order(0))  # replayable
+        assert sorted(o0.tolist()) == list(range(48))  # a true permutation
+        # a different seed is a different plan
+        other = ShardedDataset(x, y, batch_size=12, seed=8)
+        assert not np.array_equal(o0, other.epoch_order(0))
+
+    def test_seek_never_fetches_skipped_batches(self):
+        from deeplearning4j_tpu.checkpoint.manager import (
+            skip_consumed_batches)
+        from deeplearning4j_tpu.datasets.sharded import ShardedDataset
+        x, y = _dp_records()
+        sds = ShardedDataset(x, y, batch_size=12, seed=7)
+        fetched = []
+        sds.fetch_hook = lambda epoch, batch: fetched.append(batch)
+        rd = sds.reader().bind_epoch(lambda: 0)
+        full = [ds.features for ds in rd]
+        fetched.clear()
+        tail = list(skip_consumed_batches(rd, 2))
+        assert fetched == [2, 3]  # the seek primitive: nothing before 2
+        np.testing.assert_array_equal(tail[0].features, full[2])
+        np.testing.assert_array_equal(tail[1].features, full[3])
+        with pytest.raises(ValueError, match="seek"):
+            list(rd.iter_from(99))
+
+    def test_reader_enforces_equal_shard_contract(self):
+        from deeplearning4j_tpu.datasets.sharded import ShardedDataset
+        x, y = _dp_records()
+        sds = ShardedDataset(x, y, batch_size=10, seed=1)
+        with pytest.raises(ValueError, match="divisible"):
+            sds.reader(0, 4)
+        with pytest.raises(ValueError, match="out of range"):
+            sds.reader(4, 4)
+
+    def test_async_wrapper_forwards_seek_and_epoch(self):
+        from deeplearning4j_tpu.datasets import AsyncDataSetIterator
+        from deeplearning4j_tpu.datasets.sharded import ShardedDataset
+        x, y = _dp_records()
+        sds = ShardedDataset(x, y, batch_size=12, seed=3)
+        wrapped = AsyncDataSetIterator(sds.reader())
+        assert hasattr(wrapped, "iter_from")     # forwarded from the base
+        wrapped.bind_epoch(lambda: 0)
+        ref = [ds.features for ds in sds.reader().bind_epoch(lambda: 0)]
+        got = [ds.features for ds in wrapped.iter_from(1)]
+        assert len(got) == len(ref) - 1
+        np.testing.assert_array_equal(got[0], ref[1])
+        # a plain (non-seekable) base does NOT grow the seek surface
+        plain = AsyncDataSetIterator(
+            ListDataSetIterator(DataSet(x, y), 12))
+        assert not hasattr(plain, "iter_from")
+
+    def test_pre_processor_applies_on_seek_and_never_doubles(self):
+        # the resumed remainder of an epoch must see the SAME transform
+        # as plain iteration — and plain iteration must not apply it twice
+        from deeplearning4j_tpu.datasets.sharded import ShardedDataset
+        x, y = _dp_records()
+        sds = ShardedDataset(x, y, batch_size=12, seed=3)
+
+        def double(ds):
+            return DataSet(ds.features * 2.0, ds.labels)
+        rd = sds.reader().bind_epoch(lambda: 0).set_pre_processor(double)
+        plain = [ds.features for ds in rd]
+        seeked = [ds.features for ds in rd.iter_from(1)]
+        np.testing.assert_array_equal(seeked[0], plain[1])
+        raw = sds.reader().bind_epoch(lambda: 0)
+        np.testing.assert_array_equal(plain[0],
+                                      next(iter(raw)).features * 2.0)
+
+    def test_device_prefetch_wrapper_forwards_seek_and_epoch(self):
+        from deeplearning4j_tpu.datasets import AsyncDataSetIterator
+        from deeplearning4j_tpu.datasets.sharded import ShardedDataset
+        from deeplearning4j_tpu.perf.prefetch import DevicePrefetchIterator
+        x, y = _dp_records()
+        sds = ShardedDataset(x, y, batch_size=12, seed=3)
+        # the documented composition: Async innermost, prefetch outermost
+        wrapped = DevicePrefetchIterator(
+            AsyncDataSetIterator(sds.reader()))
+        assert hasattr(wrapped, "iter_from")
+        wrapped.bind_epoch(lambda: 0)
+        ref = [ds.features for ds in sds.reader().bind_epoch(lambda: 0)]
+        got = [np.asarray(ds.features) for ds in wrapped.iter_from(1)]
+        assert len(got) == len(ref) - 1
+        np.testing.assert_array_equal(got[0], ref[1])
+        plain = DevicePrefetchIterator(
+            ListDataSetIterator(DataSet(x, y), 12))
+        assert not hasattr(plain, "iter_from")
+
+    def test_streaming_segment_builds_sharded_dataset(self):
+        from deeplearning4j_tpu.datasets.sharded import ShardedDataset
+        from deeplearning4j_tpu.datasets.streaming import (
+            StreamingDataSetIterator)
+        x, y = _dp_records()
+        stream = StreamingDataSetIterator()
+        for i in range(0, 48, 16):
+            stream.push(x[i:i + 16], y[i:i + 16])
+        stream.end()
+        sds = ShardedDataset.from_iterator(stream, batch_size=12, seed=7)
+        assert sds.num_records == 48 and sds.num_batches == 4
+        ref = ShardedDataset(x, y, batch_size=12, seed=7)
+        np.testing.assert_array_equal(sds.epoch_order(0),
+                                      ref.epoch_order(0))
+        got = np.concatenate(
+            [d.features for d in sds.reader().bind_epoch(lambda: 0)])
+        np.testing.assert_array_equal(
+            got, x[ref.epoch_order(0)])
+
+
+class TestShardLeases:
+    def test_conflicting_overlap_waits_then_times_out(self):
+        from deeplearning4j_tpu.checkpoint import ObjectStoreBackend
+        from deeplearning4j_tpu.datasets.sharded import (DataLeaseTimeout,
+                                                         ShardLeaseBoard)
+        store = ObjectStoreBackend()
+        a = ShardLeaseBoard(store, "wa", ttl_s=5.0, wait_s=0.2,
+                            poll_s=0.02)
+        b = ShardLeaseBoard(store, "wb", ttl_s=5.0, wait_s=0.2,
+                            poll_s=0.02)
+        a.claim(0, 0, rank=0, world=2)
+        # overlapping slice (rows [0,.25) vs [0,.5)) → bounded wait, loud
+        with pytest.raises(DataLeaseTimeout, match="held by"):
+            b.claim(0, 0, rank=0, world=4)
+        assert b.conflicts_waited == 1
+        # disjoint slice of the same chunk claims immediately
+        b.claim(0, 0, rank=1, world=2)
+        a.release_all()
+        b.release_all()
+        assert store.list("dlease-") == []
+
+    def test_expired_lease_clears_and_stale_generation_fences(self):
+        from deeplearning4j_tpu.checkpoint import ObjectStoreBackend
+        from deeplearning4j_tpu.datasets.sharded import (
+            ShardLeaseBoard, StaleDataLeaseError)
+        store = ObjectStoreBackend()
+        now = [1000.0]
+        clock = lambda: now[0]
+        a = ShardLeaseBoard(store, "wa", ttl_s=2.0, wait_s=0.5,
+                            clock=clock)
+        b = ShardLeaseBoard(store, "wb", ttl_s=2.0, wait_s=0.5,
+                            clock=clock)
+        a.claim(0, 0, rank=0, world=1, generation=1)
+        now[0] += 3.0   # the SIGKILLed holder's lease simply expires
+        b.claim(0, 0, rank=0, world=1, generation=2)
+        # ...and the zombie coming back for a range the NEWER generation
+        # holds: the data-plane half of the split-brain fence
+        with pytest.raises(StaleDataLeaseError, match="stale"):
+            a.claim(0, 0, rank=0, world=1, generation=1)
+
+    def test_flaky_storage_rides_retries_without_double_claim(self):
+        """ISSUE 11 satellite: FlakyBackend chaos aimed at the
+        shard-lease objects (match= prefix) is ridden out by
+        RetryingBackend, and the idempotent claim + read-back means a
+        retried put can never double-claim a range."""
+        from deeplearning4j_tpu.checkpoint import (FlakyBackend,
+                                                   ObjectStoreBackend,
+                                                   RetryingBackend)
+        from deeplearning4j_tpu.datasets.sharded import (DATA_LEASE_PREFIX,
+                                                         ShardLeaseBoard)
+        inner = ObjectStoreBackend()
+        flaky = FlakyBackend(inner, seed=3, transient_rate=0.35,
+                             match=DATA_LEASE_PREFIX)
+        board = ShardLeaseBoard(
+            RetryingBackend(flaky, max_retries=8, base_backoff_s=0.0),
+            "wf", ttl_s=30.0)
+        for c in range(8):
+            board.claim(0, c, rank=0, world=1)
+        assert flaky.faults_injected > 0   # the chaos actually happened
+        assert board.claims == 8
+        leases = inner.list(DATA_LEASE_PREFIX)
+        assert len(leases) == 8            # exactly one claim per chunk
+        import json as _json
+        for name in leases:
+            rec = _json.loads(inner.get(name).decode())
+            assert rec["worker"] == "wf"
+            assert rec["incarnation"] == board.incarnation
+
+
+class TestConsumptionLedger:
+    def test_exactly_once_resume_is_bitwise_with_clean_ledger(self):
+        """Single-process acceptance slice: kill mid-epoch with per-step
+        checkpoints → train_until restores, the reader SEEKS to the exact
+        batch, the final params are bitwise-identical to the
+        uninterrupted run, and the ledger shows every record exactly once
+        per epoch in exactly the planned order."""
+        import jax
+        from deeplearning4j_tpu.checkpoint import (CheckpointManager,
+                                                   FaultInjector,
+                                                   ObjectStoreBackend)
+        from deeplearning4j_tpu.checkpoint import sharded as shd
+        from deeplearning4j_tpu.checkpoint.resume import (RestartPolicy,
+                                                          train_until)
+        from deeplearning4j_tpu.datasets.sharded import (ShardedDataset,
+                                                         reconcile_ledger)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.optimize.updaters import Sgd
+
+        def net():
+            conf = (NeuralNetConfiguration.builder().seed(5)
+                    .updater(Sgd(learning_rate=0.05))
+                    .weight_init("xavier").list()
+                    .layer(DenseLayer(n_out=8, activation="tanh"))
+                    .layer(OutputLayer(n_out=3, loss="mcxent"))
+                    .set_input_type(InputType.feed_forward(4)).build())
+            return MultiLayerNetwork(conf).init()
+
+        x, y = _dp_records()
+        ref_sds = ShardedDataset(x, y, batch_size=12, seed=9)
+        ref = net()
+        ref.fit(ref_sds.reader(), num_epochs=3)
+        ref_sha = shd.state_sha(ref)
+
+        dstore = ObjectStoreBackend()
+        sds = ShardedDataset(x, y, batch_size=12, seed=9, store=dstore,
+                             ledger=True)
+        cm = CheckpointManager(storage=ObjectStoreBackend(),
+                               save_every_n_steps=1, async_write=False)
+        victim = net()
+        victim.set_listeners(FaultInjector(kill_at_step=7))  # mid-epoch 2
+        summary = train_until(
+            victim, sds.reader(), num_epochs=3, checkpoint_manager=cm,
+            restart_policy=RestartPolicy(max_restarts=3, backoff_s=0.0))
+        assert summary.completed and summary.restarts == 1
+        assert shd.state_sha(summary.model) == ref_sha
+        report = reconcile_ledger(dstore, batch_size=12)
+        assert report.clean
+        assert report.contested == []     # same generation: keyed rewrite
+        for e in range(3):
+            assert report.epochs[e] == sds.epoch_order(e).tolist()
+        cm.close()
+
+    def test_reconcile_highest_generation_wins(self):
+        """A batch whose first training attempt was rolled back by a
+        restore may be re-consumed by a LATER generation at a different
+        world size: the newer cover is authoritative, the batch is
+        reported contested, and no record counts twice."""
+        import json as _json
+        from deeplearning4j_tpu.checkpoint import ObjectStoreBackend
+        from deeplearning4j_tpu.datasets.sharded import (LEDGER_PREFIX,
+                                                         reconcile_ledger)
+        store = ObjectStoreBackend()
+
+        def put(batch, rank, world, gen, records):
+            name = (f"{LEDGER_PREFIX}e0000-b{batch:06d}-"
+                    f"r{rank:03d}of{world:03d}")
+            store.put(name, _json.dumps({
+                "epoch": 0, "batch": batch, "rank": rank, "world": world,
+                "generation": gen, "worker": f"w{rank}",
+                "records": records}).encode())
+        # batch 0: consumed once at world 4, gen 1 (records 0..11)
+        for r in range(4):
+            put(0, r, 4, 1, list(range(r * 3, r * 3 + 3)))
+        # batch 1 (records 12..23): in-flight at gen 1 world 4 when the
+        # fleet shrank, rolled back by the restore, re-consumed at gen 2
+        # world 3 — the 4→3 reshard shape
+        for r in range(4):
+            put(1, r, 4, 1, list(range(12 + r * 3, 12 + r * 3 + 3)))
+        for r in range(3):
+            put(1, r, 3, 2, list(range(12 + r * 4, 12 + r * 4 + 4)))
+        rep = reconcile_ledger(store, batch_size=12)
+        assert rep.clean                       # no dups, no gaps
+        assert rep.epochs[0] == list(range(24))  # gen-2 cover counted once
+        assert rep.contested == [(0, 1, [1, 2])]
+        # ...and a TORN newer cover (missing rank) can never pass silently
+        store.delete(f"{LEDGER_PREFIX}e0000-b000001-r002of003")
+        rep2 = reconcile_ledger(store, batch_size=12)
+        assert (0, 1) in rep2.gaps
+
+    def test_reconcile_duplicate_record_detected(self):
+        import json as _json
+        from deeplearning4j_tpu.checkpoint import ObjectStoreBackend
+        from deeplearning4j_tpu.datasets.sharded import (LEDGER_PREFIX,
+                                                         reconcile_ledger)
+        store = ObjectStoreBackend()
+        for batch, recs in ((0, [0, 1, 2]), (1, [2, 3, 4])):  # 2 repeats
+            store.put(f"{LEDGER_PREFIX}e0000-b{batch:06d}-r000of001",
+                      _json.dumps({"epoch": 0, "batch": batch, "rank": 0,
+                                   "world": 1, "generation": 0,
+                                   "worker": "w", "records": recs}).encode())
+        rep = reconcile_ledger(store, batch_size=3)
+        assert rep.duplicates == [(0, 2)]
+        assert not rep.clean
+
+
+def test_bench_data_plane_quick_smoke():
+    """CI tripwire: the data-plane microbench runs end-to-end and emits
+    the records/s, lease-claim-latency and data-wait-fraction lines
+    (metrics only — thresholds belong to quiet full runs, 9p note)."""
+    import json as _json
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, BENCH_QUICK="1", BENCH_ONLY="data_plane",
+               JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run([sys.executable, "bench.py"], cwd=repo, env=env,
+                          capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [_json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+    assert not any("error" in l for l in lines), lines
+    by_metric = {l["metric"]: l for l in lines}
+    rps = by_metric["data_plane_records_per_sec"]
+    assert rps["value"] > 0 and rps["leased_ledgered"] > 0
+    assert by_metric["data_plane_lease_claim_us"]["value"] > 0
+    assert "async_prefetch_pct" in by_metric["data_plane_data_wait_fraction"]

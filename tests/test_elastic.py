@@ -101,6 +101,83 @@ class TestShardedCheckpoints:
         _leaves_equal(m.opt_state, net.opt_state)
         assert shd.state_sha(m) == shd.state_sha(net)
 
+    def test_selective_block_fetch_shrinks_per_host_bytes(self):
+        """ISSUE 11 satellite (streaming reshard-on-restore): a restoring
+        host that needs only the blocks its NEW sharding assigns fetches
+        only the shard objects holding them — per-host bytes read shrink
+        vs reassembling the full state — and the fetched blocks equal the
+        full restore's slices bit for bit."""
+
+        class CountingBackend(ObjectStoreBackend):
+            def __init__(self, store):
+                super().__init__(store)
+                self.bytes_read = 0
+                self.objects_read = 0
+
+            def get(self, name):
+                data = super().get(name)
+                self.bytes_read += len(data)
+                self.objects_read += 1
+                return data
+
+        net = _net(updater=Adam(0.01))
+        net.fit(_batches()[0], num_epochs=1)
+        bucket = {}
+        # journal through the manager so the per-shard block summaries
+        # ride the manifest entry (the save-side half of the satellite)
+        cm = CheckpointManager(storage=ObjectStoreBackend(bucket),
+                               sharded=True)
+        cm.save(net)
+        (entry,) = cm.checkpoints()
+        assert all(s.get("blocks") for s in entry["shards"])
+        # the manager-level surface reaches the journaled blocks
+        ref_w = np.asarray(jax.device_get(net.params[0]["W"]))
+        blocks = cm.restore_blocks(
+            lambda tree, leaf, index: leaf == "0/0/W",
+            trees=("coefficients",))
+        total = sum(arr.shape[0]
+                    for _, arr in blocks["coefficients"]["0/0/W"])
+        assert total == ref_w.shape[0]
+        # single-host set: replace it with a simulated 4-host set under
+        # the same entry shape so selection has something to select from
+        import hashlib
+        for s in entry["shards"]:
+            del bucket[s["file"]]
+        base = entry["file"][:-len(".sharded")]
+        shards = []
+        for snap in shd.simulated_shard_snapshots(net, 4):
+            data = shd.shard_zip_bytes(snap, {"seq": 1, "batch_in_epoch": 0})
+            name = shd.shard_object_name(base, snap["host"], 4)
+            bucket[name] = data
+            shards.append({"file": name, "size": len(data),
+                           "sha256": hashlib.sha256(data).hexdigest(),
+                           "blocks": shd.shard_block_summary(data)})
+        entry4 = dict(entry, num_hosts=4, shards=shards)
+
+        full = CountingBackend(bucket)
+        m, _ = shd.restore_sharded(full, entry4)
+        ref = np.asarray(jax.device_get(m.params[0]["W"]))
+
+        sel = CountingBackend(bucket)
+        # host 0's row of the first layer's W only (the 4-host split gives
+        # each host one row of the (4, 16) kernel)
+        got = shd.fetch_blocks(
+            sel, entry4,
+            lambda tree, leaf, index: leaf == "0/0/W" and index[0][0] == 0,
+            trees=("coefficients",))
+        assert sel.objects_read == 1 < full.objects_read == 4
+        assert sel.bytes_read < full.bytes_read / 2
+        for index, arr in got["coefficients"]["0/0/W"]:
+            sl = tuple(slice(a, b) for a, b in index)
+            np.testing.assert_array_equal(arr, ref[sl])
+        # pre-summary entries (older checkpoints) degrade to a full fetch
+        legacy = dict(entry4, shards=[
+            {k: v for k, v in s.items() if k != "blocks"} for s in shards])
+        sel2 = CountingBackend(bucket)
+        shd.fetch_blocks(sel2, legacy, lambda *a: False)
+        assert sel2.objects_read == 4  # correct, just not selective
+        cm.close()
+
     def test_torn_shard_falls_back_a_generation_never_mixes(self):
         net = _net()
         cm = CheckpointManager(storage=ObjectStoreBackend(), sharded=True)
@@ -472,6 +549,41 @@ class TestElasticWorkerSingleProcess:
         assert "membership bump" in summary.generations[0].ended
         assert worker.store.exists("bump-000001")
         assert summary.generations[1].restored_from is not None
+
+    def test_world1_sharded_data_plane_exactly_once(self):
+        """ISSUE 11 tentpole, in-process slice: an ElasticWorker fed a
+        ShardedDataset builds a lease-claiming reader per generation,
+        mid-epoch step-cadence checkpoints commit through
+        fit_local_shard, the consumption ledger reconciles to exactly
+        the planned epoch orders, and every lease is released at the
+        generation end."""
+        from deeplearning4j_tpu.datasets.sharded import (ShardedDataset,
+                                                         reconcile_ledger)
+        rng = np.random.default_rng(0)
+        x = rng.random((48, 4), np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 48)]
+        dstore = ObjectStoreBackend(bucket="data")
+        # batch must divide the 8-device test mesh's data axis
+        sds = ShardedDataset(x, y, batch_size=24, seed=9, store=dstore,
+                             ledger=True)
+        cm = CheckpointManager(storage=ObjectStoreBackend(), sharded=True,
+                               async_write=False, save_every_n_steps=1)
+        worker = ElasticWorker(store=ObjectStoreBackend(), worker_id="w00",
+                               checkpoint_manager=cm, num_workers=1,
+                               lease_ttl_s=1.0, poll_s=0.02,
+                               join_timeout_s=20.0)
+        summary = worker.run(_net, sds, num_epochs=2)
+        assert summary.completed and summary.model.epoch == 2
+        # step-cadence commits: epoch-0 set + every one of the 4 steps
+        # (epoch boundaries additionally re-save at the same step — the
+        # worker's unconditional boundary durability guarantee)
+        steps = [e["step"] for e in cm.checkpoints()]
+        assert sorted(set(steps)) == list(range(5))
+        report = reconcile_ledger(dstore, batch_size=24)
+        assert report.clean and report.contested == []
+        assert report.epochs[0] == sds.epoch_order(0).tolist()
+        assert report.epochs[1] == sds.epoch_order(1).tolist()
+        assert dstore.list("dlease-") == []  # released at generation end
 
     def test_repeated_failures_do_not_loop_forever(self):
         def on_generation(model, membership, rank, world):

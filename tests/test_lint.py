@@ -611,6 +611,56 @@ class TestFloatCastInQuant:
         """) == []
 
 
+class TestUnseededGlobalRng:
+    DATA_PATH = "deeplearning4j_tpu/datasets/fixture.py"
+
+    def test_fires_on_global_shuffle_in_datasets_path(self):
+        vs = _lint("""
+            import random
+            def make_epoch(items):
+                random.shuffle(items)
+                return items
+        """, path=self.DATA_PATH)
+        assert _rules(vs) == ["DLT011"]
+        assert "deterministic-epoch" in vs[0].message
+
+    def test_fires_on_np_random_permutation_and_seed(self):
+        vs = _lint("""
+            import numpy as np
+            def shard_order(n):
+                np.random.seed(0)
+                return np.random.permutation(n)
+        """, path="deeplearning4j_tpu/parallel/fixture.py")
+        assert _rules(vs) == ["DLT011", "DLT011"]
+
+    def test_seeded_instances_exempt(self):
+        # the legal idiom: seeded Generator / Random instances — pure
+        # functions of their seed, thread-local by construction
+        assert _lint("""
+            import random
+            import numpy as np
+            def make_epoch(seed, epoch, n):
+                order = np.random.default_rng([seed, epoch]).permutation(n)
+                r = random.Random(seed)
+                picks = [r.random() for _ in range(4)]
+                return order, picks
+        """, path=self.DATA_PATH) == []
+
+    def test_out_of_scope_path_clean(self):
+        assert _lint("""
+            import random
+            def jitter(d):
+                return d * random.random()
+        """, path="deeplearning4j_tpu/serving/fixture.py") == []
+
+    def test_inline_waiver(self):
+        assert _lint("""
+            import random
+            def sample_debug(items):
+                return random.sample(items, 2)  # lint: disable=DLT011 (debug only)
+        """, path=self.DATA_PATH) == []
+
+
 class TestFileWaiver:
     def test_disable_file(self):
         vs = _lint("""
