@@ -72,6 +72,9 @@ NOMINAL = {
                                   # lease claim budget
     "data_plane_wait": 10.0,    # %, nominal data-wait share of a fit
                                 # epoch before prefetch tuning
+    "autotune": 1.0,            # x, tuned-vs-default step-time ratio
+                                # (>= 1 means the record's choice is at
+                                # least as fast as the default execution)
 }
 
 
@@ -1081,6 +1084,94 @@ def bench_quantized_inference():
                   + _REPS_NOTE)
 
 
+def bench_autotune():
+    """HBM planner + compile-time autotuner (perf/planner.py,
+    perf/autotune.py): tuned-vs-default step time and activation bytes on
+    LeNet + ResNet50. For each model the autotuner searches batch/fusion/
+    donation under a budget 25% below the unplanned residual set, then the
+    DEFAULT and TUNED configurations train a few measured steps at the
+    same batch size. Metrics only per the 9p note (XLA:CPU timings do not
+    transfer); the activation-bytes column is shape-derived and stable
+    anywhere — that is the planner's acceptance number."""
+    import jax
+
+    from deeplearning4j_tpu.models import LeNet, ResNet50
+    from deeplearning4j_tpu.nn.memory import conf_memory_report
+    from deeplearning4j_tpu.perf.autotune import autotune, build_network
+    from deeplearning4j_tpu.perf.fusion import training_activation_bytes
+
+    if QUICK:
+        jobs = [("lenet", LeNet(num_classes=10).conf(), (4,), 2)]
+    else:
+        jobs = [
+            ("lenet", LeNet(num_classes=10).conf(), (64, 128, 256), 10),
+            ("resnet50",
+             ResNet50(num_classes=1000, input_shape=(224, 224, 3)).conf(),
+             (64, 128), 6),
+        ]
+    rng = np.random.default_rng(11)
+    for name, conf, batch_sizes, steps in jobs:
+        mb = min(batch_sizes)
+        rep = conf_memory_report(conf, minibatch=mb, training_bytes=False)
+        fixed = rep.total_param_bytes + rep.updater_state_bytes
+        budget = fixed + int(
+            0.75 * training_activation_bytes(conf, minibatch=mb))
+        record = autotune(conf, batch_sizes=batch_sizes,
+                          budget_bytes=budget,
+                          donation=(True,), top_k=1, reps=1 if QUICK else 2)
+        # report activation bytes AT THE RECORD'S batch size, for the
+        # record's own tuned conf — the same configuration the timing
+        # below runs (the budget above was set at mb, the search floor)
+        from deeplearning4j_tpu.perf.autotune import apply_tuning
+        b = record.batch_size
+        base_bytes = int(training_activation_bytes(conf, minibatch=b))
+        tuned_bytes = int(training_activation_bytes(
+            apply_tuning(conf, record), minibatch=b))
+
+        def steps_per_sec(net, b):
+            it = (conf.input_type if hasattr(conf, "input_type")
+                  else conf.input_types[0])
+            shape = (b, it.height, it.width, it.channels)
+            n_out = 1000 if name == "resnet50" else 10
+            x = rng.standard_normal(shape).astype(np.float32)
+            y = np.eye(n_out, dtype=np.float32)[rng.integers(0, n_out, b)]
+            net.init(validate=False)
+            from deeplearning4j_tpu.datasets.dataset import DataSet
+            ds = DataSet(x, y)
+            net.fit(ds)  # compile + warm outside the timed region
+            def run():
+                sw = Stopwatch().start()
+                for _ in range(steps):
+                    net.fit(ds)
+                sw.stop(jax.block_until_ready(net._score))
+                return sw.seconds
+            return steps / _best_of(run)
+
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        default_net = (MultiLayerNetwork(conf)
+                       if hasattr(conf, "layers") else
+                       ComputationGraph(conf))
+        tuned_net = build_network(conf, record)
+        sps_default = steps_per_sec(default_net, b)
+        sps_tuned = steps_per_sec(tuned_net, b)
+        emit(f"autotune_{name}_tuned_vs_default_step_x",
+             sps_tuned / max(sps_default, 1e-9), "x", "autotune",
+             batch=b, fusion=record.fusion,
+             remat_layers=len(record.remat),
+             candidates=record.candidates_searched,
+             default_activation_bytes=base_bytes,
+             tuned_activation_bytes=tuned_bytes,
+             activation_reduction=round(1 - tuned_bytes / base_bytes, 3),
+             budget_bytes=budget,
+             buckets=list(record.buckets),
+             note="tuned = autotune TuningRecord applied (fusion + remat "
+                  "under a budget 25% below the unplanned residual set); "
+                  "step timings metrics-only on this host per the 9p "
+                  "note — activation bytes are shape-derived and are the "
+                  "planner acceptance number. " + _REPS_NOTE)
+
+
 def bench_elastic():
     """Elastic-training path costs, metrics only (no thresholds — the 9p
     filesystem's fsync jitter swings disk-backed numbers run to run;
@@ -1299,6 +1390,7 @@ def main():
                ("data_plane", bench_data_plane),
                ("grad_compression", bench_grad_compression),
                ("quantized_inference", bench_quantized_inference),
+               ("autotune", bench_autotune),
                ("resnet50_fusion", bench_resnet50_fusion),
                ("resnet50", bench_resnet50)]
     only = os.environ.get("BENCH_ONLY")

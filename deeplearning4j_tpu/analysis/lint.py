@@ -100,6 +100,16 @@ Rules (waivable per line with ``# lint: disable=DLT00X`` or per file with
   (method calls on an instance, not the module). Waivable inline like
   DLT003.
 
+- **DLT012 compile-introspection-in-hot-path**: in serving/training hot
+  paths (``serving/``, ``parallel/``, ``nn/multilayer.py``,
+  ``nn/graph.py``), a ``.lower(...).compile()`` chain or a
+  ``.cost_analysis()`` / ``.memory_analysis()`` call re-invokes XLA
+  compilation/introspection on code that runs per request or per step —
+  seconds of compile stall on a path budgeted in microseconds. These are
+  AUTOTUNE-TIME tools (perf/autotune.py, perf/planner.py, nn/memory.py
+  reports, benches); thread their RESULTS in via a TuningRecord/plan
+  instead. Waivable inline like DLT003.
+
 Adding a rule: write a ``_rule_xxx(tree, src, path) -> List[LintViolation]``
 function and register it in ``_RULES``; tests in ``tests/test_lint.py``
 seed a fixture violating the rule and assert it fires.
@@ -792,6 +802,48 @@ def _rule_unseeded_global_rng(tree, src, path) -> List[LintViolation]:
     return out
 
 
+# ------------------------------------------------------------------ DLT012
+def _is_hot_path_file(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    if any(seg in p for seg in ("serving/", "parallel/")):
+        return True
+    return p.endswith(("nn/multilayer.py", "nn/graph.py"))
+
+
+_INTROSPECTION_CALLS = ("cost_analysis", "memory_analysis")
+
+
+def _rule_compile_introspection_in_hot_path(tree, src, path
+                                            ) -> List[LintViolation]:
+    if not _is_hot_path_file(path):
+        return []
+    out: List[LintViolation] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        attr = node.func.attr
+        hazard = None
+        if attr in _INTROSPECTION_CALLS:
+            hazard = f"'.{attr}()'"
+        elif attr == "compile":
+            recv = node.func.value
+            if isinstance(recv, ast.Call) \
+                    and isinstance(recv.func, ast.Attribute) \
+                    and recv.func.attr == "lower":
+                hazard = "'.lower(...).compile()'"
+        if hazard:
+            out.append(LintViolation(
+                path, node.lineno, "DLT012",
+                f"{hazard} in a serving/training hot path — XLA "
+                "compilation/introspection costs seconds on a path "
+                "budgeted in microseconds; these are autotune-time tools "
+                "(perf/autotune.py, perf/planner.py) — thread their "
+                "results in via a TuningRecord/plan, or waive inline for "
+                "a deliberate offline call"))
+    return out
+
+
 # ----------------------------------------------------------------- harness
 _RULES = (
     _rule_module_level_jnp,
@@ -805,6 +857,7 @@ _RULES = (
     _rule_host_work_in_compression,
     _rule_float_cast_in_quant,
     _rule_unseeded_global_rng,
+    _rule_compile_introspection_in_hot_path,
 )
 
 

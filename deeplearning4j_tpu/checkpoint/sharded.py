@@ -167,6 +167,15 @@ def shard_snapshot(model) -> dict:
         # shard once), the scheme config rides the shard metadata
         "grad_compression": None if comp is None else comp.to_config(),
         "compressState": None if cs is None else _tree_blocks(cs),
+        # augmentation + tuning ride-alongs (pure-config metadata): the
+        # SAME rng-exact resume contract as the whole-zip path — an
+        # elastic replica restoring these shards must train the identical
+        # (augmented, tuned) step or it silently diverges
+        "augmentation": (None if getattr(model, "augmentation", None)
+                         is None else model.augmentation.to_dict()),
+        "tuning_record": (None
+                          if getattr(model, "_tuning_record", None) is None
+                          else model._tuning_record.to_dict()),
     }
 
 
@@ -207,7 +216,8 @@ def simulated_shard_snapshots(model, num_hosts: int) -> List[dict]:
     for host in range(num_hosts):
         snaps.append({
             **{k: base[k] for k in ("model_type", "conf_json", "iteration",
-                                    "epoch", "grad_compression")},
+                                    "epoch", "grad_compression",
+                                    "augmentation", "tuning_record")},
             "host": host,
             "num_hosts": num_hosts,
             "coefficients": split([model.params, model.state], host),
@@ -240,6 +250,8 @@ def shard_zip_bytes(snap: dict, extra_meta: Optional[dict] = None) -> bytes:
         "has_rng": snap["rng"] is not None,
         "grad_compression": snap.get("grad_compression"),
         "has_compress": snap.get("compressState") is not None,
+        "augmentation": snap.get("augmentation"),
+        "tuning_record": snap.get("tuning_record"),
     }
     meta.update(extra_meta or {})
     index, arrays = [], {}
@@ -377,6 +389,13 @@ def restore_from_payloads(payloads: List[bytes], load_updater: bool = True):
             if meta.get("has_compress") else None
         restore_compress_state(model, meta["grad_compression"], cs,
                                origin="sharded")
+    if meta.get("augmentation"):
+        from deeplearning4j_tpu.datasets.augment import ImageAugmentation
+        model.augmentation = ImageAugmentation.from_dict(
+            meta["augmentation"])
+    if meta.get("tuning_record"):
+        from deeplearning4j_tpu.perf.autotune import TuningRecord
+        model._tuning_record = TuningRecord.from_dict(meta["tuning_record"])
     if meta_p["rng"] is not None:
         model._rng = jax.random.wrap_key_data(jnp.asarray(meta_p["rng"]))
     model.iteration = int(meta.get("iteration", 0))

@@ -1,0 +1,111 @@
+"""On-device data augmentation: pure-jnp crop/flip/normalize INSIDE the
+jitted train step.
+
+Host-side augmentation (numpy per-batch transforms in the input pipeline)
+pays a full host pass over every image plus the transfer of the augmented
+copy; on a TPU the same ops are bandwidth-trivial next to the conv work
+already on device. ``ImageAugmentation`` is a frozen config whose
+``apply(x, rng)`` runs inside the traced loss: MultiLayerNetwork /
+ComputationGraph thread a key split off the STEP rng into it, so
+augmentation is deterministic given the training seed (the same
+reproducibility contract as dropout), replays bitwise across
+checkpoint-resume, and costs zero host work.
+
+Because augmentation runs inside the forward, it changes the
+forward→backward residual set — ``perf.fusion.training_activation_bytes``
+and the HBM planner (``perf/planner.py``) take an ``augmentation=`` knob so
+the planned memory accounts for it.
+
+This is the PR 11 leftover (on-device augmentation was independent of the
+lease/resume machinery); reference analogue: DataVec's ImageTransform
+pipeline, which runs on the host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ImageAugmentation:
+    """Random crop / horizontal flip / per-channel normalize for NHWC
+    batches, as pure traced ops.
+
+    ``crop_padding``: zero-pad H and W by this much, then take a random
+    H×W crop per example (the CIFAR recipe); 0 disables.
+    ``flip_prob``: per-example probability of a horizontal (width-axis)
+    flip; 0 disables.
+    ``mean``/``std``: per-channel normalize ``(x - mean) / std`` applied
+    AFTER the geometric ops; None disables.
+
+    Frozen and hashable — the networks key their jit caches on it, so
+    changing the augmentation mints a fresh compiled step instead of
+    silently reusing the old one."""
+
+    crop_padding: int = 0
+    flip_prob: float = 0.0
+    mean: Optional[Tuple[float, ...]] = None
+    std: Optional[Tuple[float, ...]] = None
+
+    def __post_init__(self):
+        if self.crop_padding < 0:
+            raise ValueError(f"crop_padding must be >= 0, got "
+                             f"{self.crop_padding}")
+        if not 0.0 <= self.flip_prob <= 1.0:
+            raise ValueError(f"flip_prob must be in [0, 1], got "
+                             f"{self.flip_prob}")
+        if (self.mean is None) != (self.std is None):
+            raise ValueError("mean and std must be set together")
+
+    def to_dict(self) -> dict:
+        return {
+            "crop_padding": self.crop_padding,
+            "flip_prob": self.flip_prob,
+            "mean": None if self.mean is None else list(self.mean),
+            "std": None if self.std is None else list(self.std),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ImageAugmentation":
+        return cls(
+            crop_padding=int(d.get("crop_padding", 0)),
+            flip_prob=float(d.get("flip_prob", 0.0)),
+            mean=(None if d.get("mean") is None
+                  else tuple(float(v) for v in d["mean"])),
+            std=(None if d.get("std") is None
+                 else tuple(float(v) for v in d["std"])),
+        )
+
+    def apply(self, x, rng):
+        """Augment one NHWC batch under ``rng`` (a jax PRNG key). Pure and
+        shape-preserving: output shape == input shape, so bucket ladders
+        and compiled-step shapes are untouched."""
+        if x.ndim != 4:
+            raise ValueError(
+                f"ImageAugmentation.apply expects NHWC (batch, h, w, c); "
+                f"got rank-{x.ndim} input")
+        n, h, w, _ = x.shape
+        k_oy, k_ox, k_flip = jax.random.split(rng, 3)
+        if self.crop_padding:
+            p = int(self.crop_padding)
+            padded = jnp.pad(x, ((0, 0), (p, p), (p, p), (0, 0)))
+            oy = jax.random.randint(k_oy, (n,), 0, 2 * p + 1)
+            ox = jax.random.randint(k_ox, (n,), 0, 2 * p + 1)
+
+            def crop_one(img, y0, x0):
+                return jax.lax.dynamic_slice(
+                    img, (y0, x0, 0), (h, w, img.shape[-1]))
+
+            x = jax.vmap(crop_one)(padded, oy, ox)
+        if self.flip_prob:
+            flip = jax.random.bernoulli(k_flip, self.flip_prob, (n,))
+            x = jnp.where(flip[:, None, None, None], x[:, :, ::-1, :], x)
+        if self.mean is not None:
+            mean = jnp.asarray(self.mean, x.dtype)
+            std = jnp.asarray(self.std, x.dtype)
+            x = (x - mean) / std
+        return x

@@ -623,6 +623,177 @@ fused_bn_act_train.defvjp(_fused_bn_act_fwd, _fused_bn_act_bwd)
 
 @register_layer
 @dataclasses.dataclass(frozen=True)
+class FusedSeparableConvBNActivation(BaseLayer):
+    """SeparableConvolution2D → train-mode BatchNorm → activation as ONE
+    layer sharing :func:`fused_bn_act_train`'s memory-efficient VJP (the
+    BN backward recomputes x-hat from the saved pointwise-conv output plus
+    O(C) mean/inv-std). Produced by ``perf.fusion.fuse`` from matched
+    SeparableConvolution2D → BatchNormalization → ActivationLayer chains
+    (the PR 4 leftover); math identical to the unfused stack within fp
+    tolerance. Non-residual only — depthwise stems don't sit on residual
+    adds in the reference topologies."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    kernel_size: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    convolution_mode: str = "truncate"
+    depth_multiplier: int = 1
+    has_bias: bool = False
+    activation: str = "relu"
+    decay: float = 0.9
+    eps: float = 1e-5
+    gamma: float = 1.0
+    beta: float = 0.0
+
+    def input_kind(self):
+        return "cnn"
+
+    def regularizable(self):
+        return ("W_dw", "W_pw")
+
+    def output_type(self, it: InputType) -> InputType:
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        h = _conv_out(it.height, kh, sh, ph, self.convolution_mode)
+        w = _conv_out(it.width, kw, sw, pw, self.convolution_mode)
+        return InputType.convolutional(h, w, self.n_out)
+
+    def with_n_in(self, n_in):
+        return self
+
+    def init(self, rng, it: InputType, dtype=jnp.float32):
+        kh, kw = _pair(self.kernel_size)
+        c_in = self.n_in or it.channels
+        k1, k2 = jax.random.split(rng)
+        dw_out = c_in * self.depth_multiplier
+        params = {
+            "W_dw": init_weights(k1, (kh, kw, 1, dw_out), kh * kw,
+                                 kh * kw * self.depth_multiplier,
+                                 self.weight_init, self.dist, dtype),
+            "W_pw": init_weights(k2, (1, 1, dw_out, self.n_out), dw_out,
+                                 self.n_out, self.weight_init, self.dist,
+                                 dtype),
+        }
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        params["gamma"] = jnp.full((self.n_out,), self.gamma, dtype)
+        params["beta"] = jnp.full((self.n_out,), self.beta, dtype)
+        state = {"mean": jnp.zeros((self.n_out,), dtype),
+                 "var": jnp.ones((self.n_out,), dtype)}
+        return params, state
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        from deeplearning4j_tpu.perf.compile_watch import bump_active
+        bump_active("fusion.fused_block")
+        x = dropout_input(x, self.dropout, train, rng)
+        z = lax.conv_general_dilated(
+            x, params["W_dw"], window_strides=_pair(self.stride),
+            padding=_padding_cfg(self.convolution_mode, self.padding),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=x.shape[-1])
+        z = lax.conv_general_dilated(
+            z, params["W_pw"], window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return _fused_bn_tail(self, params, state, z, train)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class FusedConv1DBNActivation(BaseLayer):
+    """Convolution1DLayer → train-mode BatchNorm → activation as ONE layer
+    over (batch, time, channels), sharing :func:`fused_bn_act_train`'s
+    memory-efficient VJP (the normalize axes are 'all but last', so the
+    same custom VJP covers NWC exactly as it covers NHWC). Produced by
+    ``perf.fusion.fuse`` from matched Convolution1DLayer →
+    BatchNormalization → ActivationLayer chains (the PR 4 leftover)."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    kernel_size: int = 3
+    stride: int = 1
+    padding: int = 0
+    convolution_mode: str = "truncate"
+    dilation: int = 1
+    has_bias: bool = False
+    activation: str = "relu"
+    decay: float = 0.9
+    eps: float = 1e-5
+    gamma: float = 1.0
+    beta: float = 0.0
+
+    def input_kind(self):
+        return "rnn"
+
+    def is_recurrent(self):
+        return True
+
+    def output_type(self, it: InputType) -> InputType:
+        t = it.timeseries_length
+        if t is not None:
+            t = _conv_out(t, self.kernel_size, self.stride, self.padding,
+                          self.convolution_mode, self.dilation)
+        return InputType.recurrent(self.n_out, t)
+
+    def init(self, rng, it: InputType, dtype=jnp.float32):
+        c_in = self.n_in or it.size
+        fan_in = c_in * self.kernel_size
+        fan_out = self.n_out * self.kernel_size
+        params = {"W": init_weights(rng, (self.kernel_size, c_in, self.n_out),
+                                    fan_in, fan_out, self.weight_init,
+                                    self.dist, dtype)}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        params["gamma"] = jnp.full((self.n_out,), self.gamma, dtype)
+        params["beta"] = jnp.full((self.n_out,), self.beta, dtype)
+        state = {"mean": jnp.zeros((self.n_out,), dtype),
+                 "var": jnp.ones((self.n_out,), dtype)}
+        return params, state
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        from deeplearning4j_tpu.perf.compile_watch import bump_active
+        bump_active("fusion.fused_block")
+        x = dropout_input(x, self.dropout, train, rng)
+        pad = ("SAME" if self.convolution_mode == "same"
+               else ((self.padding, self.padding),))
+        z = lax.conv_general_dilated(
+            x, params["W"], window_strides=(self.stride,), padding=pad,
+            rhs_dilation=(self.dilation,),
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return _fused_bn_tail(self, params, state, z, train)
+
+
+def _fused_bn_tail(layer, params, state, z, train):
+    """Shared BN(+activation) tail of the fused blocks: train mode goes
+    through the memory-efficient custom VJP, eval mode through the folded
+    running-stat scale/shift — identical to FusedConvBNActivation.apply's
+    non-residual path."""
+    gamma, beta = params["gamma"], params["beta"]
+    if train:
+        out, mean, var = fused_bn_act_train(layer.activation, layer.eps,
+                                            z, gamma, beta, None)
+        new_state = {
+            "mean": layer.decay * state["mean"] + (1.0 - layer.decay) * mean,
+            "var": layer.decay * state["var"] + (1.0 - layer.decay) * var,
+        }
+        return out, new_state
+    mean, var = state["mean"], state["var"]
+    sdt = var.dtype
+    inv = lax.rsqrt(var + jnp.asarray(layer.eps, sdt))
+    scale = gamma.astype(sdt) * inv
+    shift = beta.astype(sdt) - mean * scale
+    pre = z * scale.astype(z.dtype) + shift.astype(z.dtype)
+    return get_activation(layer.activation)(pre), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
 class FusedConvBNActivation(BaseLayer):
     """Conv → train-mode BatchNorm → activation (optionally + residual add
     before the activation) as ONE layer whose BN backward recomputes x-hat

@@ -84,6 +84,10 @@ class ComputationGraph:
         # error-feedback state threaded through the jitted step
         self.grad_compression = None
         self.compress_state = None
+        # on-device augmentation (datasets/augment.py) — applied to every
+        # 4-D (NHWC) network input inside the jitted train step; part of
+        # the jit-cache key (see set_augmentation)
+        self.augmentation = None
         self._jit_cache = {}
         # per-network compile/dispatch counters (perf/compile_watch.py)
         self.compile_watch = CompileWatch("ComputationGraph")
@@ -256,6 +260,13 @@ class ComputationGraph:
         """Loss over all output layers; with ``carries`` the recurrent
         vertices run their stateful path and the aux also returns the new
         carries (shared by the standard and tBPTT steps)."""
+        if self.augmentation is not None and rng is not None:
+            # in-graph augmentation of every image-shaped input, seeded per
+            # input off ONE split of the step key (train-mode only; the
+            # score path calls with rng=None)
+            rng, ak = jax.random.split(rng)
+            inputs = [self.augmentation.apply(x, jax.random.fold_in(ak, i))
+                      if x.ndim == 4 else x for i, x in enumerate(inputs)]
         fwd = self._forward(params, state, inputs, True, rng, fmasks, carries)
         if carries is None:
             acts, preouts, new_state, mask_of = fwd
@@ -462,10 +473,18 @@ class ComputationGraph:
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
+    def set_augmentation(self, augmentation) -> "ComputationGraph":
+        """Enable on-device augmentation (datasets/augment.py) for the
+        jitted train step — same contract as
+        MultiLayerNetwork.set_augmentation; applied to 4-D (NHWC) inputs
+        only."""
+        self.augmentation = augmentation
+        return self
+
     def _get_jitted(self, kind):
-        # the compression scheme is part of the cache key (see
-        # multilayer.py): enabling grad_compression mints a fresh step
-        key = (kind, self.grad_compression)
+        # the compression scheme AND augmentation config are part of the
+        # cache key (see multilayer.py): changing either mints a fresh step
+        key = (kind, self.grad_compression, self.augmentation)
         fn = self._jit_cache.get(key)
         if fn is None:
             if kind == "train":

@@ -197,7 +197,8 @@ def _abstract_layer_stats(layer, it, key, itemsize: int):
     return n_params, p_bytes, p
 
 
-def conf_memory_report(conf, input_type=None, minibatch: int = 32) -> MemoryReport:
+def conf_memory_report(conf, input_type=None, minibatch: int = 32,
+                       training_bytes: bool = True) -> MemoryReport:
     """Memory report for a CONFIGURATION — no network, no device buffers.
 
     Consumes the shape-inference pass (``layer_input_types`` /
@@ -206,7 +207,11 @@ def conf_memory_report(conf, input_type=None, minibatch: int = 32) -> MemoryRepo
     ``InputType`` chain, and updater state from ``jax.eval_shape`` of the
     optax transform's init over the abstract params. Accepts a
     MultiLayerConfiguration (``input_type`` may override the configured one)
-    or a ComputationGraphConfiguration."""
+    or a ComputationGraphConfiguration. ``training_bytes=False`` skips the
+    jaxpr-derived training-activation-bytes measurement (a full abstract
+    trace — seconds on large graphs); callers that only need the
+    param/updater/per-layer tables (perf/planner.py measures residuals
+    itself) opt out."""
     itemsize = jnp.dtype(conf.dtype).itemsize
     key = jax.random.key(0)
     reports: List[LayerMemoryReport] = []
@@ -264,12 +269,15 @@ def conf_memory_report(conf, input_type=None, minibatch: int = 32) -> MemoryRepo
 
     # the measured fwd->bwd residual set (fusion/remat-aware); best-effort:
     # inference-only confs (no loss layer) and exotic label shapes skip it
-    try:
-        from deeplearning4j_tpu.perf.fusion import training_activation_bytes
-        train_bytes = int(training_activation_bytes(conf,
-                                                    minibatch=minibatch))
-    except Exception:
-        train_bytes = None
+    train_bytes = None
+    if training_bytes:
+        try:
+            from deeplearning4j_tpu.perf.fusion import (
+                training_activation_bytes)
+            train_bytes = int(training_activation_bytes(conf,
+                                                        minibatch=minibatch))
+        except Exception:
+            train_bytes = None
 
     return MemoryReport(
         model_class=type(conf).__name__,

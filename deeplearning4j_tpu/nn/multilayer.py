@@ -101,6 +101,19 @@ class MultiLayerNetwork:
         # restored from checkpoint metadata by utils/serialization.
         self.grad_compression = None
         self.compress_state = None
+        # on-device augmentation (datasets/augment.py): applied to the
+        # features INSIDE the jitted train step, seeded from the step rng.
+        # Part of the jit-cache key — see set_augmentation.
+        self.augmentation = None
+
+    def set_augmentation(self, augmentation) -> "MultiLayerNetwork":
+        """Enable on-device augmentation (a frozen
+        ``datasets.augment.ImageAugmentation``, or None to disable): the
+        train step augments its feature batch in-graph, seeded from the
+        step rng key, so epochs stay deterministic and resume replays
+        bitwise. Inference/score paths are unaffected (no rng there)."""
+        self.augmentation = augmentation
+        return self
 
     # ------------------------------------------------------------------ init
     def init(self, seed: Optional[int] = None,
@@ -238,6 +251,12 @@ class MultiLayerNetwork:
         out_layer = self.layers[-1]
         if not out_layer.is_output_layer():
             raise ValueError("Last layer must be an output/loss layer to fit()")
+        if self.augmentation is not None and rng is not None:
+            # in-graph augmentation off a split of the STEP key: train-mode
+            # only (score/eval call with rng=None) and deterministic per
+            # (seed, step) — the dropout reproducibility contract
+            rng, ak = jax.random.split(rng)
+            x = self.augmentation.apply(x, ak)
         acts, preout, new_state, cur_mask, _ = self._forward(params, state, x, True, rng, fmask)
         lm = lmask if lmask is not None else (cur_mask if cur_mask is not None else None)
         if y.dtype in (jnp.bfloat16, jnp.float16):
@@ -575,10 +594,10 @@ class MultiLayerNetwork:
         return self._rnn_carries
 
     def _get_jitted(self, kind, key=()):
-        # the compression scheme is part of the cache key: enabling (or
-        # changing) grad_compression mints a fresh compressed step instead
-        # of reusing the uncompressed program under the same name
-        k = (kind, self.grad_compression) + tuple(key)
+        # the compression scheme AND the augmentation config are part of
+        # the cache key: enabling (or changing) either mints a fresh step
+        # instead of reusing the old compiled program under the same name
+        k = (kind, self.grad_compression, self.augmentation) + tuple(key)
         fn = self._jit_cache.get(k)
         if fn is None:
             if kind == "train":
@@ -1048,6 +1067,7 @@ class MultiLayerNetwork:
             other.opt_state = jax.tree_util.tree_map(jnp.array, self.opt_state)
             other._rng = self._rng
         other.grad_compression = self.grad_compression
+        other.augmentation = self.augmentation
         if self.compress_state is not None:
             other.compress_state = jax.tree_util.tree_map(
                 jnp.array, self.compress_state)

@@ -116,6 +116,13 @@ class ParallelInference:
     job's commits keep serving quantized (see quant/ docs for the
     accuracy-gate step that should precede this).
 
+    tuning: a ``perf.autotune.TuningRecord`` (or None to inherit the
+    model's ``_tuning_record`` restored from a zip/checkpoint): the
+    record's serving bucket ladder becomes the bucket policy and is warmed
+    at construction, so a tuned endpoint compiles NOTHING at serve time.
+    A record searched on a different architecture is refused
+    (``StaleTuningRecordError``).
+
     checkpoint hot-swap: ``start_hot_swap(checkpoint_manager)`` watches the
     manager's journal for a newer step and atomically swaps the new params
     in BETWEEN dispatches — no request is dropped, none observes a
@@ -133,13 +140,29 @@ class ParallelInference:
                  quantize=None, checkpoint_manager=None,
                  checkpoint_poll_secs: Optional[float] = None,
                  queue_depth: int = 1024,
-                 queue_put_timeout_ms: float = 50.0):
+                 queue_put_timeout_ms: float = 50.0,
+                 tuning=None):
         if inference_mode not in ("batched", "sequential"):
             raise ValueError(f"unknown inference_mode '{inference_mode}'")
         if int(queue_depth) < 1:
             raise ValueError(f"queue_depth must be >= 1; got {queue_depth}")
         if queue_put_timeout_ms < 0:
             raise ValueError("queue_put_timeout_ms must be >= 0")
+        self._tuning = tuning
+        if tuning is None:
+            # a model restored from a zip/checkpoint carrying tuning.json
+            # brings its record along — inherit it unless overridden
+            self._tuning = tuning = getattr(model, "_tuning_record", None)
+        if tuning is not None:
+            # a tuning is only valid for the architecture it was searched
+            # on (StaleTuningRecordError on mismatch — the quant/ stale-
+            # record contract); checked BEFORE fold/quantize rebuild the
+            # model, against the raw conf the record was searched on
+            from deeplearning4j_tpu.perf.autotune import verify_tuning
+            verify_tuning(model.conf, tuning)
+            if (bucket_policy is ParallelInference._DEFAULT_POLICY
+                    and tuning.buckets):
+                bucket_policy = BucketPolicy(buckets=tuning.buckets)
         self._fold_bn = bool(fold_bn)
         self._quantize = quantize
         # read checkpoint provenance BEFORE folding/quantizing: both
@@ -240,9 +263,45 @@ class ParallelInference:
                  "target (bucket ladder pad waste)",
             buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
         absorb_inference_stats(reg, self)
+        if tuning is not None and tuning.buckets:
+            # warm the RECORDED ladder now, so a tuned endpoint pays zero
+            # compiles at serve time (the TuningRecord contract); best-
+            # effort — models whose input shape the conf cannot describe
+            # (multi-input graphs, index sequences) warm on first traffic
+            ex = self._tuning_example()
+            if ex is not None:
+                try:
+                    self.warmup(ex, buckets=tuning.buckets)
+                except Exception:
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "tuning-ladder warmup failed; serving continues "
+                        "(first dispatch per bucket will compile)",
+                        exc_info=True)
         if checkpoint_manager is not None:
             self.start_hot_swap(checkpoint_manager,
                                 poll_secs=checkpoint_poll_secs)
+
+    def _tuning_example(self) -> Optional[np.ndarray]:
+        """A zero example with the conf-described feature shape, for
+        warming the TuningRecord's bucket ladder; None when the conf does
+        not pin a single float input shape."""
+        conf = self.model.conf
+        it = getattr(conf, "input_type", None)
+        if it is None:
+            its = getattr(conf, "input_types", None) or ()
+            if len(its) != 1:
+                return None
+            it = its[0]
+        if it is None:
+            return None
+        if it.kind in ("rnn", "cnn1d") and it.timeseries_length is None:
+            return None  # no canonical length to warm at
+        try:
+            shape = it.example_shape(1)
+        except ValueError:
+            return None
+        return np.zeros(shape, np.float32)
 
     # --------------------------------------------------------- shape policy
     def _pad_target(self, n: int) -> int:
@@ -525,6 +584,11 @@ class ParallelInference:
             "row_size": self._size_summary(rows),
             "bucket_policy": (None if self.bucket_policy is None
                               else repr(self.bucket_policy)),
+            "tuning": {
+                "applied": self._tuning is not None,
+                "buckets": (list(self._tuning.buckets)
+                            if self._tuning is not None else None),
+            },
             "warmed_buckets": warmed,
             "bucket_dispatches": bucket_dispatches,
             "unwarmed_dispatches": unwarmed,

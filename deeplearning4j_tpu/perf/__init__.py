@@ -10,9 +10,19 @@ Three cooperating pieces (see each module's docstring):
 - ``compile_watch`` — CompileWatch: compile/dispatch counters so tests and
                       benches can assert "N batches, 1 compile";
 - ``fusion``        — fuse/fuse_network (Conv→BN→Act fused blocks with a
-                      memory-efficient custom VJP), fold_bn (inference-time
-                      BN folding), remat policies, and the jaxpr-derived
-                      training_activation_bytes measurement.
+                      memory-efficient custom VJP — 2-D, separable and 1-D
+                      heads), fold_bn (inference-time BN folding, residual
+                      blocks included), remat policies, and the
+                      jaxpr-derived training_activation_bytes measurement;
+- ``planner``       — plan_memory: fit training under a stated HBM budget
+                      by searching fusion + per-layer remat against the
+                      measured residual set (predict → verify;
+                      BudgetInfeasibleError when nothing fits);
+- ``autotune``      — compile-time autotuner over batch size / fusion /
+                      donation / bucket ladders using
+                      jit(...).lower().compile().cost_analysis(), emitting
+                      a persisted TuningRecord that training replicas and
+                      serving endpoints inherit.
 """
 
 from deeplearning4j_tpu.perf.bucketing import (  # noqa: F401
@@ -37,3 +47,18 @@ from deeplearning4j_tpu.perf.fusion import (  # noqa: F401
     training_activation_bytes,
 )
 from deeplearning4j_tpu.perf.prefetch import DevicePrefetchIterator  # noqa: F401
+from deeplearning4j_tpu.perf.planner import (  # noqa: F401
+    BudgetInfeasibleError,
+    MemoryPlan,
+    PlanError,
+    plan_memory,
+)
+from deeplearning4j_tpu.perf.autotune import (  # noqa: F401
+    StaleTuningRecordError,
+    TuningRecord,
+    apply_tuning,
+    autotune,
+    build_network,
+    conf_signature,
+    verify_tuning,
+)

@@ -13,7 +13,9 @@ attacks exactly that traffic, three ways:
   FusedConvBNActivation` block whose ``jax.custom_vjp`` BN backward
   recomputes x-hat from the saved conv output plus O(C) mean/inv-std —
   eliminating the activation-sized save/re-read pairs (the In-Place
-  Activated BatchNorm recipe, Bulò et al. CVPR 2018).
+  Activated BatchNorm recipe, Bulò et al. CVPR 2018). SeparableConv2D and
+  Conv1D chain heads match too (FusedSeparableConvBNActivation /
+  FusedConv1DBNActivation share the same custom VJP).
 
 - ``fold_bn(net)`` — serving-time constant folding: BN's inference-mode
   scale/shift folds into the preceding conv's weights/bias, so inference
@@ -45,7 +47,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.nn.conf.convolutional import (
-    ConvolutionLayer, FusedConvBNActivation,
+    Convolution1DLayer, ConvolutionLayer, FusedConv1DBNActivation,
+    FusedConvBNActivation, FusedSeparableConvBNActivation,
+    SeparableConvolution2D,
 )
 from deeplearning4j_tpu.nn.conf.graph import (
     ComputationGraphConfiguration, ElementWiseVertex,
@@ -109,6 +113,18 @@ def _conv_matchable(conv) -> bool:
             and conv.activation == "identity")
 
 
+# chain heads the rewriter matches ahead of a BatchNormalization. The fused
+# block classes subclass BaseLayer directly, so isinstance checks on the
+# plain conv classes cannot re-match an already-fused block.
+_FUSABLE_HEADS = (ConvolutionLayer, SeparableConvolution2D,
+                  Convolution1DLayer)
+
+
+def _head_matchable(layer) -> bool:
+    return (isinstance(layer, _FUSABLE_HEADS)
+            and layer.activation == "identity")
+
+
 def _bn_matchable(conv, bn) -> bool:
     return (isinstance(bn, BatchNormalization)
             and not bn.lock_gamma_beta
@@ -150,6 +166,40 @@ def _make_fused(conv: ConvolutionLayer, bn: BatchNormalization,
         residual=residual)
 
 
+def _common_fused_kwargs(conv, bn, activation: str,
+                         name: Optional[str]) -> dict:
+    return dict(
+        name=name if name is not None else conv.name,
+        dropout=conv.dropout, remat=conv.remat, activation=activation,
+        weight_init=conv.weight_init, dist=conv.dist,
+        bias_init=conv.bias_init,
+        l1=conv.l1, l2=conv.l2, l1_bias=conv.l1_bias, l2_bias=conv.l2_bias,
+        updater=conv.updater,
+        gradient_normalization=conv.gradient_normalization,
+        gradient_normalization_threshold=conv.gradient_normalization_threshold,
+        constraints=conv.constraints, weight_noise=conv.weight_noise,
+        n_in=conv.n_in, n_out=conv.n_out, kernel_size=conv.kernel_size,
+        stride=conv.stride, padding=conv.padding,
+        convolution_mode=conv.convolution_mode, has_bias=conv.has_bias,
+        decay=bn.decay, eps=bn.eps, gamma=bn.gamma, beta=bn.beta)
+
+
+def _make_fused_head(conv, bn, activation: str, residual: bool = False,
+                     name: Optional[str] = None):
+    """Fused block for any matchable chain head (2-D conv, separable conv,
+    1-D conv). Residual adds only exist on the 2-D path."""
+    if isinstance(conv, ConvolutionLayer):
+        return _make_fused(conv, bn, activation, residual=residual, name=name)
+    assert not residual, "residual fusion is 2-D-conv only"
+    kw = _common_fused_kwargs(conv, bn, activation, name)
+    if isinstance(conv, SeparableConvolution2D):
+        return FusedSeparableConvBNActivation(
+            depth_multiplier=conv.depth_multiplier, **kw)
+    if isinstance(conv, Convolution1DLayer):
+        return FusedConv1DBNActivation(dilation=conv.dilation, **kw)
+    raise TypeError(f"unfusable chain head {type(conv).__name__}")
+
+
 # -------------------------------------------------------------- MLN rewrite
 def _fuse_multilayer(conf: MultiLayerConfiguration):
     """Returns (fused conf, mapping). mapping entries: ("copy", i) or
@@ -164,7 +214,7 @@ def _fuse_multilayer(conf: MultiLayerConfiguration):
         l = layers[i]
         fused = None
         span = 1
-        if (_conv_matchable(l) and i + 1 < len(layers)
+        if (_head_matchable(l) and i + 1 < len(layers)
                 and (i + 1) not in pres and _bn_matchable(l, layers[i + 1])):
             bn = layers[i + 1]
             act, span = "identity", 2
@@ -172,7 +222,7 @@ def _fuse_multilayer(conf: MultiLayerConfiguration):
             if (i + 2 < len(layers) and (i + 2) not in pres
                     and _act_matchable(layers[i + 2])):
                 act, span, act_i = layers[i + 2].activation, 3, i + 2
-            fused = _make_fused(l, bn, act)
+            fused = _make_fused_head(l, bn, act)
         if i in pres:
             new_pres[len(new_layers)] = pres[i]
         if fused is not None:
@@ -207,7 +257,7 @@ def _fuse_graph(conf: ComputationGraphConfiguration):
                 consumers.setdefault(inp, []).append(n)
         for cname in list(vertices):
             cobj, cins = vertices[cname]
-            if not _conv_matchable(cobj):
+            if not _head_matchable(cobj):
                 continue
             if cname in outputs or len(consumers.get(cname, ())) != 1:
                 continue
@@ -223,7 +273,8 @@ def _fuse_graph(conf: ComputationGraphConfiguration):
             act = "identity"
             if _act_matchable(nobj) and nins == (bname,):
                 act_name, act = nxt, nobj.activation
-            elif (isinstance(nobj, ElementWiseVertex)
+            elif (isinstance(cobj, ConvolutionLayer)  # residual: 2-D only
+                  and isinstance(nobj, ElementWiseVertex)
                   and nobj.op.lower() == "add" and len(nins) == 2
                   and nxt not in outputs
                   and len(consumers.get(nxt, ())) == 1):
@@ -233,9 +284,9 @@ def _fuse_graph(conf: ComputationGraphConfiguration):
                     add_name, act_name, act = nxt, anxt, aobj.activation
                     res_input = nins[0] if nins[1] == bname else nins[1]
             new_name = act_name if act_name is not None else bname
-            fused = _make_fused(cobj, bobj, act,
-                                residual=res_input is not None,
-                                name=cobj.name or cname)
+            fused = _make_fused_head(cobj, bobj, act,
+                                     residual=res_input is not None,
+                                     name=cobj.name or cname)
             inputs = (cins[0],) + ((res_input,) if res_input else ())
             vertices[new_name] = (fused, inputs)
             for dead in (cname, bname, add_name):
@@ -289,9 +340,9 @@ def fuse_network(net):
                     state.append(_copy_tree(net.state[entry[1]]))
                 else:
                     _, ci, bi, _ = entry
-                    p = {"W": jnp.array(net.params[ci]["W"])}
-                    if "b" in net.params[ci]:
-                        p["b"] = jnp.array(net.params[ci]["b"])
+                    # the fused param layout is the head conv's keys
+                    # (W / W_dw+W_pw[, b]) plus the BN's gamma/beta
+                    p = {k: jnp.array(v) for k, v in net.params[ci].items()}
                     p["gamma"] = jnp.array(net.params[bi]["gamma"])
                     p["beta"] = jnp.array(net.params[bi]["beta"])
                     params.append(p)
@@ -311,9 +362,8 @@ def fuse_network(net):
                     params[name] = _copy_tree(net.params[name])
                     state[name] = _copy_tree(net.state[name])
                 else:
-                    p = {"W": jnp.array(net.params[src["conv"]]["W"])}
-                    if "b" in net.params[src["conv"]]:
-                        p["b"] = jnp.array(net.params[src["conv"]]["b"])
+                    p = {k: jnp.array(v)
+                         for k, v in net.params[src["conv"]].items()}
                     p["gamma"] = jnp.array(net.params[src["bn"]]["gamma"])
                     p["beta"] = jnp.array(net.params[src["bn"]]["beta"])
                     params[name] = p
@@ -352,6 +402,21 @@ def _fold_conv_params(conv_params, has_bias, scale, shift):
     return {"W": w * scale, "b": b * scale + shift}
 
 
+def _fold_head_params(layer, params, scale, shift):
+    """Fold a per-channel (scale, shift) into the head conv's parameters:
+    into W's output-channel axis for 2-D/1-D convolutions, into the
+    pointwise W_pw for separable convolutions (the depthwise stage is
+    untouched — BN sits after the pointwise mix)."""
+    if isinstance(layer, (SeparableConvolution2D,
+                          FusedSeparableConvBNActivation)):
+        w_pw = jnp.asarray(params["W_pw"], jnp.float32)
+        b = (jnp.asarray(params["b"], jnp.float32) if layer.has_bias
+             else jnp.zeros((w_pw.shape[-1],), jnp.float32))
+        return {"W_dw": jnp.asarray(params["W_dw"], jnp.float32),
+                "W_pw": w_pw * scale, "b": b * scale + shift}
+    return _fold_conv_params(params, layer.has_bias, scale, shift)
+
+
 def fold_bn(net):
     """Serving-time BN folding: every Conv(activation=identity)→BatchNorm
     pair — and every non-residual FusedConvBNActivation block — collapses
@@ -360,10 +425,13 @@ def fold_bn(net):
 
     Returns a NEW network of the same class whose inference output matches
     the BN-inference output within fp tolerance and whose graph contains no
-    foldable BN; residual fused blocks and BN not directly behind an
-    identity-activation conv are left in place. Train-mode semantics are NOT
-    preserved (batch stats no longer exist) — fold for inference/export
-    only. Updater state is reset."""
+    foldable BN. Separable (fold into the pointwise W_pw) and 1-D conv
+    heads fold too, as do all fused blocks: residual
+    FusedConvBNActivation vertices expand back into the BN-free
+    conv → add → activation triple (the activation keeps the vertex name).
+    BN not directly behind an identity-activation conv is left in place.
+    Train-mode semantics are NOT preserved (batch stats no longer exist) —
+    fold for inference/export only. Updater state is reset."""
     from deeplearning4j_tpu.nn.graph import ComputationGraph
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
@@ -391,6 +459,35 @@ def _unfuse_to_conv(fl: FusedConvBNActivation) -> ConvolutionLayer:
         has_bias=True)
 
 
+# fused blocks fold_bn can collapse back into their BN-free head conv
+_FOLDABLE_FUSED = (FusedConvBNActivation, FusedSeparableConvBNActivation,
+                   FusedConv1DBNActivation)
+
+
+def _unfuse_head(fl):
+    """The BN-free conv the folded fused block collapses into (bias always
+    materialized — it absorbs the BN shift)."""
+    if isinstance(fl, FusedConvBNActivation):
+        return _unfuse_to_conv(fl)
+    common = dict(
+        name=fl.name, dropout=fl.dropout, remat=fl.remat,
+        activation=fl.activation, weight_init=fl.weight_init, dist=fl.dist,
+        bias_init=fl.bias_init, l1=fl.l1, l2=fl.l2, l1_bias=fl.l1_bias,
+        l2_bias=fl.l2_bias, updater=fl.updater,
+        gradient_normalization=fl.gradient_normalization,
+        gradient_normalization_threshold=fl.gradient_normalization_threshold,
+        constraints=fl.constraints, weight_noise=fl.weight_noise,
+        n_in=fl.n_in, n_out=fl.n_out, kernel_size=fl.kernel_size,
+        stride=fl.stride, padding=fl.padding,
+        convolution_mode=fl.convolution_mode, has_bias=True)
+    if isinstance(fl, FusedSeparableConvBNActivation):
+        return SeparableConvolution2D(depth_multiplier=fl.depth_multiplier,
+                                      **common)
+    if isinstance(fl, FusedConv1DBNActivation):
+        return Convolution1DLayer(dilation=fl.dilation, **common)
+    raise TypeError(f"not a fused block: {type(fl).__name__}")
+
+
 def _fold_bn_multilayer(net):
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
@@ -405,22 +502,23 @@ def _fold_bn_multilayer(net):
         l = layers[i]
         if i in pres:
             new_pres[len(new_layers)] = pres[i]
-        if (_conv_matchable(l) and i + 1 < len(layers)
+        if (_head_matchable(l) and i + 1 < len(layers)
                 and isinstance(layers[i + 1], BatchNormalization)
                 and (i + 1) not in pres):
             bn = layers[i + 1]
             scale, shift = _bn_scale_shift(bn, net.params[i + 1],
                                            net.state[i + 1])
             new_layers.append(dataclasses.replace(l, has_bias=True))
-            new_params.append(_fold_conv_params(net.params[i], l.has_bias,
-                                                scale, shift))
+            new_params.append(_fold_head_params(l, net.params[i], scale,
+                                                shift))
             new_state.append({})
             i += 2
-        elif isinstance(l, FusedConvBNActivation) and not l.residual:
+        elif (isinstance(l, _FOLDABLE_FUSED)
+              and not getattr(l, "residual", False)):
             scale, shift = _bn_scale_shift(l, net.params[i], net.state[i])
-            new_layers.append(_unfuse_to_conv(l))
-            new_params.append(_fold_conv_params(net.params[i], l.has_bias,
-                                                scale, shift))
+            new_layers.append(_unfuse_head(l))
+            new_params.append(_fold_head_params(l, net.params[i], scale,
+                                                shift))
             new_state.append({})
             i += 1
         else:
@@ -445,15 +543,40 @@ def _fold_bn_graph(net):
     params = {n: _copy_tree(net.params[n]) for n in net.params}
     state = {n: _copy_tree(net.state[n]) for n in net.state}
 
-    # standalone fused blocks first (no topology change)
+    # standalone fused blocks first; non-residual ones fold in place (no
+    # topology change), residual ones expand back into the BN-free
+    # conv → add → activation triple — the PR 4 leftover: a fold_bn'd
+    # ResNet50 serving graph now contains NO fused block at all
     for name in list(vertices):
         obj, ins = vertices[name]
-        if isinstance(obj, FusedConvBNActivation) and not obj.residual:
+        if isinstance(obj, _FOLDABLE_FUSED) \
+                and not getattr(obj, "residual", False):
             scale, shift = _bn_scale_shift(obj, params[name], state[name])
-            vertices[name] = (_unfuse_to_conv(obj), ins)
-            params[name] = _fold_conv_params(params[name], obj.has_bias,
-                                             scale, shift)
+            vertices[name] = (_unfuse_head(obj), ins)
+            params[name] = _fold_head_params(obj, params[name], scale,
+                                             shift)
             state[name] = {}
+        elif isinstance(obj, FusedConvBNActivation) and obj.residual:
+            scale, shift = _bn_scale_shift(obj, params[name], state[name])
+            conv = dataclasses.replace(_unfuse_to_conv(obj),
+                                       activation="identity")
+            conv_name, add_name = f"{name}.fold_conv", f"{name}.fold_add"
+            while conv_name in vertices:
+                conv_name += "_"
+            while add_name in vertices:
+                add_name += "_"
+            # the ActivationLayer keeps the fused vertex's NAME, so every
+            # downstream reference (and the network outputs) keep resolving
+            vertices[conv_name] = (conv, (ins[0],))
+            vertices[add_name] = (ElementWiseVertex(op="add"),
+                                  (conv_name, ins[1]))
+            vertices[name] = (ActivationLayer(activation=obj.activation),
+                              (add_name,))
+            params[conv_name] = _fold_head_params(obj, params[name], scale,
+                                                  shift)
+            state[conv_name] = {}
+            params[add_name], state[add_name] = {}, {}
+            params[name], state[name] = {}, {}
 
     changed = True
     while changed:
@@ -464,7 +587,7 @@ def _fold_bn_graph(net):
                 consumers.setdefault(inp, []).append(n)
         for cname in list(vertices):
             cobj, cins = vertices[cname]
-            if not _conv_matchable(cobj):
+            if not _head_matchable(cobj):
                 continue
             if cname in outputs or len(consumers.get(cname, ())) != 1:
                 continue
@@ -479,8 +602,8 @@ def _fold_bn_graph(net):
             # reference keeps resolving
             vertices[bname] = (dataclasses.replace(cobj, has_bias=True),
                                cins)
-            params[bname] = _fold_conv_params(params[cname], cobj.has_bias,
-                                              scale, shift)
+            params[bname] = _fold_head_params(cobj, params[cname], scale,
+                                              shift)
             state[bname] = {}
             vertices.pop(cname)
             params.pop(cname)
@@ -525,14 +648,18 @@ def _labels_struct(out_layer, out_type, minibatch: int):
     return jax.ShapeDtypeStruct((minibatch, n_out), jnp.float32)
 
 
-def training_activation_bytes(conf, minibatch: int = 32) -> int:
+def training_activation_bytes(conf, minibatch: int = 32,
+                              augmentation=None) -> int:
     """Measured training-activation bytes for a configuration: the size of
     the residual set the REAL train-mode loss forward hands its backward,
     derived from the jaxpr (``jax.make_jaxpr`` over abstract inputs — zero
     device allocation). Fusion and ``remat=`` knobs change this number the
     same way they change the compiled step's HBM traffic, which makes it
     the ablation metric for ``bench.py``'s fusion on/off run and the
-    training-activation-bytes line of ``conf.memory_report()``."""
+    training-activation-bytes line of ``conf.memory_report()``.
+    ``augmentation`` (datasets/augment.ImageAugmentation) measures the step
+    WITH on-device augmentation in the graph — augmentation changes the
+    residual set, so the HBM planner passes it through."""
     from deeplearning4j_tpu.analysis.validation import (
         _abstract_init, _input_struct, _is_index_layer,
     )
@@ -542,6 +669,7 @@ def training_activation_bytes(conf, minibatch: int = 32) -> int:
         if conf.input_type is None:
             raise ValueError("training_activation_bytes needs an input_type")
         net = MultiLayerNetwork(conf)
+        net.augmentation = augmentation
         types = conf.layer_input_types()
         params, state = [], []
         for layer, it in zip(net.layers, types):
@@ -565,6 +693,7 @@ def training_activation_bytes(conf, minibatch: int = 32) -> int:
         from deeplearning4j_tpu.nn.conf.layers import Layer
         from deeplearning4j_tpu.nn.graph import ComputationGraph
         net = ComputationGraph(conf)
+        net.augmentation = augmentation
         params, state = {}, {}
         for name in net.order:
             obj, _ = net.vertices[name]

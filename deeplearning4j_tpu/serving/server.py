@@ -482,24 +482,28 @@ class ModelServer:
                   batch_limit: Optional[int] = None,
                   fold_bn: bool = False, quantize=None,
                   checkpoint_manager=None,
-                  checkpoint_poll_secs: Optional[float] = None
-                  ) -> ModelEndpoint:
+                  checkpoint_poll_secs: Optional[float] = None,
+                  tuning=None) -> ModelEndpoint:
         """Register a model (several nets behind one server, each with its
         own ``ParallelInference``, queue and breaker). ``quantize`` takes a
         ``quant.CalibrationRecord``: the endpoint serves the int8 lowering
         (``ParallelInference(quantize=)``) — re-applied on every checkpoint
-        hot-swap — and accepts int8 binary predict payloads."""
+        hot-swap — and accepts int8 binary predict payloads. ``tuning``
+        takes a ``perf.autotune.TuningRecord``: the endpoint serves on the
+        record's bucket ladder, warmed at registration
+        (``ParallelInference(tuning=)``), so it compiles nothing at serve
+        time."""
         if name in self.endpoints:
             raise ValueError(f"model '{name}' already registered")
-        if quantize is not None and isinstance(model, (ModelEndpoint,
-                                                       ParallelInference)):
+        if (quantize is not None or tuning is not None) \
+                and isinstance(model, (ModelEndpoint, ParallelInference)):
             # a pre-built PI/endpoint already owns its serving graph —
-            # silently dropping the record would serve fp32 while the
-            # caller believes the endpoint is quantized
+            # silently dropping the record would serve untuned/fp32 while
+            # the caller believes the record is applied
             raise ValueError(
-                "add_model(quantize=) needs the raw network — pass the "
-                "model itself, or build the ParallelInference with "
-                "quantize= and register that")
+                "add_model(quantize=/tuning=) needs the raw network — pass "
+                "the model itself, or build the ParallelInference with "
+                "quantize=/tuning= and register that")
         if isinstance(model, ModelEndpoint):
             ep = model
             ep.name = name
@@ -518,7 +522,7 @@ class ModelServer:
                 queue_depth=(self._default_queue_depth if queue_depth is None
                              else queue_depth),
                 queue_put_timeout_ms=0.0,  # over capacity ⇒ IMMEDIATE 429
-                fold_bn=fold_bn, quantize=quantize,
+                fold_bn=fold_bn, quantize=quantize, tuning=tuning,
                 checkpoint_manager=checkpoint_manager,
                 checkpoint_poll_secs=checkpoint_poll_secs)
             ep = ModelEndpoint(
@@ -540,8 +544,16 @@ class ModelServer:
         ``warmup_async=False`` to block until ready)."""
         handler = type("BoundServingHandler", (_Handler,),
                        {"server_ref": self})
-        self._httpd = ThreadingHTTPServer((self.bind_address, self.port),
-                                          handler)
+        # socketserver's default listen backlog is 5: a burst of
+        # simultaneous connects (far-above-capacity offered load — exactly
+        # what this tier exists to absorb) can then overflow the TCP
+        # accept queue and surface as kernel connection RESETS instead of
+        # the admission layer's typed 429s. Deepen the backlog so sheds
+        # happen in OUR code, with Retry-After, not in the kernel's.
+        server_cls = type("BacklogThreadingHTTPServer",
+                          (ThreadingHTTPServer,),
+                          {"request_queue_size": 128})
+        self._httpd = server_cls((self.bind_address, self.port), handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="model-server", daemon=True)

@@ -14,6 +14,10 @@ Zip layout mirrors the reference's:
   live in the config/coefficients entries, so restore rebuilds the exact
   quantized predict and this record lets serving re-apply the SAME
   lowering to newer fp32 checkpoints)
+- ``tuning.json``         — perf/autotune TuningRecord (present iff the model
+  carries one): the autotuned batch size / fusion / remat / serving bucket
+  ladder, so training replicas and serving endpoints restoring this model
+  inherit the tuned execution without re-searching
 
 The checkpoint/ subsystem extends this layout with ``rngState.npz`` (the
 training PRNG key via ``jax.random.key_data``) and extra metadata
@@ -89,14 +93,17 @@ def write_model(model, path: str, save_updater: bool = True):
         model_type = "ComputationGraph"
     else:
         raise TypeError(f"Cannot serialize {type(model)}")
+    aug = getattr(model, "augmentation", None)
     meta = {
         "format_version": FORMAT_VERSION,
         "model_type": model_type,
         "iteration": model.iteration,
         "epoch": model.epoch,
         "has_updater": bool(save_updater),
+        "augmentation": None if aug is None else aug.to_dict(),
     }
     cal = getattr(model, "_quant_calibration", None)
+    tun = getattr(model, "_tuning_record", None)
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
         z.writestr("configuration.json", model.conf.to_json())
         z.writestr("metadata.json", json.dumps(meta))
@@ -107,6 +114,8 @@ def write_model(model, path: str, save_updater: bool = True):
                        _save_npz_bytes(_flatten_with_paths(model.opt_state)))
         if cal is not None:
             z.writestr("quantization.json", cal.to_json())
+        if tun is not None:
+            z.writestr("tuning.json", tun.to_json())
 
 
 def snapshot_training_state(model) -> dict:
@@ -130,11 +139,20 @@ def snapshot_training_state(model) -> dict:
     comp = getattr(model, "grad_compression", None)
     cs = getattr(model, "compress_state", None)
     cal = getattr(model, "_quant_calibration", None)
+    tun = getattr(model, "_tuning_record", None)
     return {
         # quant/ ride-along: a checkpointed QUANTIZED serving model (its
         # int8 weights are ordinary params) restores with the calibration
         # record it was lowered with
         "quant_calibration": None if cal is None else cal.to_dict(),
+        # perf/autotune ride-along: the tuned execution config travels
+        # with the checkpoint so restored replicas inherit it
+        "tuning_record": None if tun is None else tun.to_dict(),
+        # on-device augmentation ride-along (datasets/augment.py): the
+        # augmented train step is part of the rng-exact resume contract —
+        # a restored replica training WITHOUT it would silently diverge
+        "augmentation": (None if getattr(model, "augmentation", None)
+                         is None else model.augmentation.to_dict()),
         "model_type": model_type,
         "conf_json": model.conf.to_json(),
         "iteration": int(model.iteration),
@@ -171,6 +189,8 @@ def checkpoint_zip_bytes(snap: dict, extra_meta: dict = None) -> bytes:
         "grad_compression": snap.get("grad_compression"),
         "has_compress_state": snap.get("compress_state") is not None,
         "has_quant_calibration": snap.get("quant_calibration") is not None,
+        "has_tuning_record": snap.get("tuning_record") is not None,
+        "augmentation": snap.get("augmentation"),
     }
     meta.update(extra_meta or {})
     buf = io.BytesIO()
@@ -191,6 +211,9 @@ def checkpoint_zip_bytes(snap: dict, extra_meta: dict = None) -> bytes:
         if snap.get("quant_calibration") is not None:
             z.writestr("quantization.json",
                        json.dumps(snap["quant_calibration"], sort_keys=True))
+        if snap.get("tuning_record") is not None:
+            z.writestr("tuning.json",
+                       json.dumps(snap["tuning_record"], sort_keys=True))
     return buf.getvalue()
 
 
@@ -230,6 +253,8 @@ def restore_checkpoint(path, load_updater: bool = True):
         if meta.get("grad_compression"):
             _restore_compression(model, meta, z)
         _restore_quant_calibration(model, z)
+        _restore_tuning_record(model, z)
+        _restore_augmentation(model, meta)
         model.iteration = meta.get("iteration", 0)
         model.epoch = meta.get("epoch", 0)
     return model, meta
@@ -243,6 +268,26 @@ def _restore_quant_calibration(model, z: zipfile.ZipFile):
         from deeplearning4j_tpu.quant.calibrate import CalibrationRecord
         model._quant_calibration = CalibrationRecord.from_json(
             z.read("quantization.json").decode())
+
+
+def _restore_tuning_record(model, z: zipfile.ZipFile):
+    """Re-attach the perf/autotune TuningRecord when one rides in the zip
+    (the tuned conf itself — fused layers, remat knobs — round-trips
+    through the config JSON like any other configuration)."""
+    if "tuning.json" in z.namelist():
+        from deeplearning4j_tpu.perf.autotune import TuningRecord
+        model._tuning_record = TuningRecord.from_json(
+            z.read("tuning.json").decode())
+
+
+def _restore_augmentation(model, meta: dict):
+    """Re-enable on-device augmentation when the checkpoint metadata
+    carries its config — the resumed train step must augment exactly like
+    the interrupted one or the rng-exact resume silently diverges."""
+    if meta.get("augmentation"):
+        from deeplearning4j_tpu.datasets.augment import ImageAugmentation
+        model.augmentation = ImageAugmentation.from_dict(
+            meta["augmentation"])
 
 
 def _restore_compression(model, meta: dict, z: zipfile.ZipFile):
@@ -293,6 +338,8 @@ def _restore(path, expect, load_updater):
             upd = dict(np.load(io.BytesIO(z.read("updaterState.npz"))))
             model.opt_state = _restore_into(model.opt_state, upd)
         _restore_quant_calibration(model, z)
+        _restore_tuning_record(model, z)
+        _restore_augmentation(model, meta)
         model.iteration = meta.get("iteration", 0)
         model.epoch = meta.get("epoch", 0)
     return model

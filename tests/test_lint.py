@@ -661,6 +661,63 @@ class TestUnseededGlobalRng:
         """, path=self.DATA_PATH) == []
 
 
+class TestCompileIntrospectionInHotPath:
+    SERVING_PATH = "deeplearning4j_tpu/serving/fixture.py"
+
+    def test_fires_on_lower_compile_in_serving(self):
+        vs = _lint("""
+            import jax
+            def dispatch(step, args):
+                compiled = step.lower(*args).compile()
+                return compiled(*args)
+        """, path=self.SERVING_PATH)
+        assert _rules(vs) == ["DLT012"]
+        assert "autotune-time" in vs[0].message
+
+    def test_fires_on_cost_analysis_in_parallel(self):
+        vs = _lint("""
+            def serve_batch(compiled, x):
+                cost = compiled.cost_analysis()
+                return compiled(x), cost
+        """, path="deeplearning4j_tpu/parallel/fixture.py")
+        assert _rules(vs) == ["DLT012"]
+
+    def test_fires_on_memory_analysis_in_train_path(self):
+        vs = _lint("""
+            def _fit_batch(self, step, ds):
+                ma = step.lower(ds).compile().memory_analysis()
+                return ma
+        """, path="deeplearning4j_tpu/nn/multilayer.py")
+        # the .lower().compile() chain AND the introspection call both fire
+        assert _rules(vs) == ["DLT012", "DLT012"]
+
+    def test_autotune_and_memory_report_out_of_scope(self):
+        # the tools that OWN lower/compile introspection stay clean: the
+        # autotuner, the planner, nn/memory reports, benches
+        src = """
+            def estimate(step, args):
+                return step.lower(*args).compile().cost_analysis()
+        """
+        for path in ("deeplearning4j_tpu/perf/autotune.py",
+                     "deeplearning4j_tpu/nn/memory.py",
+                     "bench.py"):
+            assert _lint(src, path=path) == []
+
+    def test_plain_compile_not_flagged(self):
+        # an ordinary .compile() (regex, template) is not the XLA chain
+        assert _lint("""
+            import re
+            def route(pattern, path):
+                return re.compile(pattern).match(path)
+        """, path=self.SERVING_PATH) == []
+
+    def test_inline_waiver(self):
+        assert _lint("""
+            def dispatch(step, args):
+                return step.lower(*args).compile()  # lint: disable=DLT012 (warmup path, offline)
+        """, path=self.SERVING_PATH) == []
+
+
 class TestFileWaiver:
     def test_disable_file(self):
         vs = _lint("""
