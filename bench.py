@@ -72,6 +72,8 @@ NOMINAL = {
                                   # lease claim budget
     "data_plane_wait": 10.0,    # %, nominal data-wait share of a fit
                                 # epoch before prefetch tuning
+    "retrieval": 10_000.0,      # queries/sec, nominal GPU brute-force
+                                # ANN server at ~100k vectors
     "autotune": 1.0,            # x, tuned-vs-default step-time ratio
                                 # (>= 1 means the record's choice is at
                                 # least as fast as the default execution)
@@ -1380,6 +1382,78 @@ def bench_data_plane():
               "only — thresholds on quiet full runs per the 9p note.")
 
 
+def bench_retrieval():
+    """Vector retrieval: device-batched QPS + recall@10 for brute / IVF /
+    int8-IVF vs the host-side VPTree, at 100k and 1M vectors (QUICK: one
+    tiny corpus). Metrics only on CPU per the 9p note; the VPTree
+    comparison is capped at 100k vectors (a million-node host tree takes
+    minutes to build and proves nothing new about the host baseline)."""
+    from deeplearning4j_tpu.clustering.vptree import VPTree
+    from deeplearning4j_tpu.retrieval import (BruteForceIndex, IVFIndex,
+                                              recall_at_k,
+                                              synthetic_corpus)
+
+    sizes = [(2_000, 32)] if QUICK else [(100_000, 64), (1_000_000, 64)]
+    n_queries = 64 if QUICK else 1024
+    batch = 64 if QUICK else 256
+    k = 10
+    for n, d in sizes:
+        V, Q = synthetic_corpus(n, d, n_clusters=max(16, n // 200),
+                                seed=0, queries=n_queries)
+
+        def qps_of(ix):
+            ix.warmup(max_queries=batch, ks=(k,))
+
+            def timed():
+                sw = Stopwatch().start()
+                outs = None
+                for lo in range(0, n_queries, batch):
+                    outs = ix.search(Q[lo:lo + batch], k)
+                # search() already fetched to host; bare stop is synced
+                del outs
+                return sw.stop()
+            return n_queries / _best_of(timed)
+
+        indexes = {
+            "brute": BruteForceIndex(V),
+            "ivf": IVFIndex(V),
+            "ivf_int8": IVFIndex(V, int8=True),
+        }
+        exact = indexes["brute"]
+        # host-tree baseline: per-query tree walks on one CPU thread.
+        # Capped at 100k vectors (a million-node host tree takes minutes
+        # to build); the metric is NAMED by the tree's actual corpus so
+        # the 1M device numbers never masquerade as a 1M host baseline.
+        n_tree = min(n, 100_000)
+        tree = VPTree(V[:n_tree])
+        n_tree_q = min(n_queries, 32)
+        sw = Stopwatch().start()
+        for row in Q[:n_tree_q]:
+            tree.search(row, k)
+        tree_qps = n_tree_q / sw.stop()
+        emit(f"retrieval_vptree_host_{n_tree // 1000}k_qps", tree_qps,
+             "queries/sec", "retrieval",
+             note=f"host VPTree baseline over {n_tree} vectors "
+                  "(single-thread per-query tree walk)")
+        for name, ix in indexes.items():
+            qps = qps_of(ix)
+            rec = (1.0 if name == "brute"
+                   else recall_at_k(ix, Q, k, exact=exact))
+            extra = {}
+            if n_tree == n:
+                extra["speedup_vs_vptree"] = round(qps / tree_qps, 1)
+            else:  # different corpus sizes: an apples-to-apples ratio
+                extra[f"vs_vptree_{n_tree // 1000}k_corpus"] = \
+                    round(qps / tree_qps, 1)
+            emit(f"retrieval_{name}_{n // 1000}k_qps", qps,
+                 "queries/sec", "retrieval",
+                 recall_at_10=round(rec, 4),
+                 index_mb=round(ix.nbytes() / 1e6, 2),
+                 note="device-batched top-k, batch "
+                      f"{batch}, warmed pow2 ladder. " + _REPS_NOTE,
+                 **extra)
+
+
 def main():
     benches = [("lenet", bench_lenet), ("word2vec", bench_word2vec),
                ("charlstm", bench_graveslstm), ("serving", bench_serving),
@@ -1388,6 +1462,7 @@ def main():
                ("resilience", bench_resilience),
                ("elastic", bench_elastic),
                ("data_plane", bench_data_plane),
+               ("retrieval", bench_retrieval),
                ("grad_compression", bench_grad_compression),
                ("quantized_inference", bench_quantized_inference),
                ("autotune", bench_autotune),

@@ -110,6 +110,19 @@ Rules (waivable per line with ``# lint: disable=DLT00X`` or per file with
   reports, benches); thread their RESULTS in via a TuningRecord/plan
   instead. Waivable inline like DLT003.
 
+- **DLT013 host-work-in-retrieval-hot-path**: the retrieval scoring path
+  (``retrieval/``) exists to keep the whole query batch on device — one
+  matmul + ``lax.top_k`` per dispatch, zero host syncs (the trace_check
+  tier-1 gate). Host work inside a scoring function — ``np.*`` distance
+  math, ``.item()``, ``jax.device_get`` — silently reintroduces the
+  per-query host round-trip the host VPTree already had. Scope (the
+  DLT009 mixed host/device shape): in ``retrieval/`` files, functions
+  that are jit-decorated (``@jax.jit`` / ``@functools.partial(jax.jit,
+  ...)``) or whose name contains ``score``/``topk``/``probe``, and that
+  use ``jnp``/``lax`` device math; pure-host helpers (builders, wire
+  codecs, the padding wrappers around the dispatch) are exempt by
+  construction. Waivable inline like DLT003.
+
 Adding a rule: write a ``_rule_xxx(tree, src, path) -> List[LintViolation]``
 function and register it in ``_RULES``; tests in ``tests/test_lint.py``
 seed a fixture violating the rule and assert it fires.
@@ -595,7 +608,8 @@ def _rule_metric_registration(tree, src, path) -> List[LintViolation]:
 def _is_bounded_buffer_path(path: str) -> bool:
     p = path.replace(os.sep, "/")
     return any(seg in p for seg in ("serving/", "parallel/", "datasets/",
-                                    "storage/", "checkpoint/"))
+                                    "storage/", "checkpoint/",
+                                    "retrieval/"))
 
 
 def _rule_unbounded_queue(tree, src, path) -> List[LintViolation]:
@@ -844,6 +858,86 @@ def _rule_compile_introspection_in_hot_path(tree, src, path
     return out
 
 
+# ------------------------------------------------------------------ DLT013
+_RETRIEVAL_HOT_TOKENS = ("score", "topk", "probe")
+
+
+def _is_retrieval_path(path: str) -> bool:
+    return "retrieval/" in path.replace(os.sep, "/")
+
+
+def _is_jit_decorated(fn, aliases) -> bool:
+    """``@jax.jit`` or ``@functools.partial(jax.jit, ...)`` (the repo's
+    static-argnames idiom)."""
+    for dec in fn.decorator_list:
+        if _resolve(_dotted(dec), aliases) == "jax.jit":
+            return True
+        if isinstance(dec, ast.Call):
+            if _resolve(_dotted(dec.func), aliases) == "jax.jit":
+                return True
+            if _resolve(_dotted(dec.func), aliases) == "functools.partial" \
+                    and dec.args \
+                    and _resolve(_dotted(dec.args[0]), aliases) == "jax.jit":
+                return True
+    return False
+
+
+def _rule_host_work_in_retrieval(tree, src, path) -> List[LintViolation]:
+    if not _is_retrieval_path(path):
+        return []
+    aliases = _import_aliases(tree)
+    out: List[LintViolation] = []
+
+    def uses_device_math(fn) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Attribute, ast.Name)):
+                q = _resolve(_dotted(node), aliases)
+                if q.startswith(("jax.numpy", "jax.lax")):
+                    return True
+        return False
+
+    def in_scope_functions():
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            name = node.name.lower()
+            if (_is_jit_decorated(node, aliases)
+                    or any(t in name for t in _RETRIEVAL_HOT_TOKENS)):
+                if uses_device_math(node):
+                    yield node
+
+    # dedup on the CALL node, not the function: a hot-path function
+    # nested inside another hot-path function is walked by both, and the
+    # same np call must report once (ast.walk(tree) yields each
+    # FunctionDef once, so a function-id set would be dead code)
+    seen_calls: Set[int] = set()
+    for fn in in_scope_functions():
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in seen_calls:
+                continue
+            q = _resolve(_dotted(node.func), aliases)
+            hazard = None
+            if q == "numpy" or q.startswith("numpy."):
+                hazard = f"'{q}(...)' (host numpy)"
+            elif q == "jax.device_get":
+                hazard = "'jax.device_get(...)'"
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item":
+                hazard = "'.item()'"
+            if hazard:
+                seen_calls.add(id(node))
+                out.append(LintViolation(
+                    path, node.lineno, "DLT013",
+                    f"{hazard} inside retrieval hot-path function "
+                    f"'{fn.name}' — the scoring path is one jitted "
+                    "matmul+top_k per batch with ZERO host syncs; host "
+                    "distance math or device readbacks here reintroduce "
+                    "the per-query host round-trip the device index "
+                    "exists to kill; keep the kernel in jnp (or waive "
+                    "inline for a deliberately host-side helper)"))
+    return out
+
+
 # ----------------------------------------------------------------- harness
 _RULES = (
     _rule_module_level_jnp,
@@ -858,6 +952,7 @@ _RULES = (
     _rule_float_cast_in_quant,
     _rule_unseeded_global_rng,
     _rule_compile_introspection_in_hot_path,
+    _rule_host_work_in_retrieval,
 )
 
 

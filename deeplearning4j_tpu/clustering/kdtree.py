@@ -41,28 +41,33 @@ class KDTree:
         return node
 
     def search(self, target, k: int) -> Tuple[List[int], List[float]]:
-        """k nearest indices + euclidean distances, ascending."""
+        """k nearest indices + euclidean distances, ascending. TIE-STABLE
+        like VPTree.search: equal distances resolve to the lower index
+        (the heap orders lexicographically on (d, i), and the far-side
+        bound is INCLUSIVE so an equal-distance lower-index point across
+        the splitting plane is still reached) — exactly the first k of
+        ``sorted((d_i, i))``, deterministic on duplicate-heavy inputs."""
         if k < 1:
             raise ValueError(f"k must be >= 1; got {k}")
         target = np.asarray(target, np.float64)
-        heap: List[Tuple[float, int]] = []
+        heap: List[Tuple[float, int]] = []  # (-d, -i): heap[0] = worst kept
 
         def visit(node: Optional[_KDNode]):
             if node is None:
                 return
             d = float(np.linalg.norm(self.items[node.index] - target))
             if len(heap) < k:
-                heapq.heappush(heap, (-d, node.index))
-            elif d < -heap[0][0]:
-                heapq.heapreplace(heap, (-d, node.index))
+                heapq.heappush(heap, (-d, -node.index))
+            elif (d, node.index) < (-heap[0][0], -heap[0][1]):
+                heapq.heapreplace(heap, (-d, -node.index))
             diff = target[node.axis] - self.items[node.index, node.axis]
             near, far = (node.left, node.right) if diff < 0 else (node.right, node.left)
             visit(near)
-            if len(heap) < k or abs(diff) < -heap[0][0]:
+            if len(heap) < k or abs(diff) <= -heap[0][0]:
                 visit(far)
 
         visit(self._root)
-        pairs = sorted((-nd, i) for nd, i in heap)
+        pairs = sorted((-nd, -ni) for nd, ni in heap)
         return [i for _, i in pairs], [d for d, _ in pairs]
 
     def nn(self, target) -> Tuple[int, float]:

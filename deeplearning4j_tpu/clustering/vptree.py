@@ -95,20 +95,26 @@ class VPTree:
     # --------------------------------------------------------------- search
     def search(self, target, k: int) -> Tuple[List[int], List[float]]:
         """k nearest item indices + distances, ascending (reference
-        VPTree.search)."""
+        VPTree.search). TIE-STABLE: equal distances resolve to the lower
+        index — the result is exactly the first k of ``sorted((d_i, i))``,
+        deterministic even on duplicate-heavy inputs, which is what lets
+        this tree serve as the device indexes' recall oracle."""
         if k < 1:
             raise ValueError(f"k must be >= 1; got {k}")
         target = np.asarray(target, np.float64)
-        heap: List[Tuple[float, int]] = []  # max-heap via negated distance
+        # max-heap via negated (distance, index): heap[0] is the WORST
+        # kept candidate under the lexicographic (d, i) order
+        heap: List[Tuple[float, int]] = []
         tau = [np.inf]
 
         def offer(d: float, index: int):
-            if d < tau[0] or len(heap) < k:
-                if len(heap) == k:
-                    heapq.heappop(heap)
-                heapq.heappush(heap, (-d, index))
+            if len(heap) < k:
+                heapq.heappush(heap, (-d, -index))
                 if len(heap) == k:
                     tau[0] = -heap[0][0]
+            elif (d, index) < (-heap[0][0], -heap[0][1]):
+                heapq.heapreplace(heap, (-d, -index))
+                tau[0] = -heap[0][0]
 
         # iterative near-first traversal (far side pushed with its pruning
         # test deferred to pop time, when tau is tighter)
@@ -136,5 +142,5 @@ class VPTree:
                          else (node.outside, node.inside))
             stack.append((far, d, node.radius))   # popped after near subtree
             stack.append((near, None, None))
-        pairs = sorted((-nd, i) for nd, i in heap)
+        pairs = sorted((-nd, -ni) for nd, ni in heap)
         return [i for _, i in pairs], [d for d, _ in pairs]

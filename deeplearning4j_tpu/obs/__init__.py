@@ -28,8 +28,9 @@ Turn it all on in three lines::
 
 from deeplearning4j_tpu.obs.registry import (  # noqa: F401
     Counter, Gauge, Histogram, MetricError, MetricsRegistry,
-    absorb_checkpoint_manager, absorb_compile_watch, absorb_inference_stats,
-    absorb_model_server, absorb_training_stats, get_registry,
+    absorb_checkpoint_manager, absorb_compile_watch, absorb_index_endpoint,
+    absorb_inference_stats, absorb_model_server, absorb_training_stats,
+    get_registry,
     publish_stats_update, watch_grad_compression, watch_training_stats)
 from deeplearning4j_tpu.obs.trace import (  # noqa: F401
     Stopwatch, Tracer, configure_tracer, get_tracer)
@@ -44,6 +45,7 @@ __all__ = [
     "get_registry", "absorb_compile_watch", "absorb_training_stats",
     "watch_training_stats", "watch_grad_compression",
     "absorb_inference_stats", "absorb_checkpoint_manager",
+    "absorb_index_endpoint",
     "publish_stats_update",
     "Tracer", "get_tracer", "configure_tracer", "Stopwatch",
     "FlightRecorder", "install_flight_recorder", "get_flight_recorder",

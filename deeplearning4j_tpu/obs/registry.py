@@ -449,6 +449,60 @@ def absorb_inference_stats(registry: MetricsRegistry, pi):
     return _cb
 
 
+def absorb_index_endpoint(registry: MetricsRegistry, ep):
+    """Register a collect-time callback pulling a retrieval
+    ``IndexEndpoint``'s stats — query/batch totals, queue pressure,
+    hot-swap rebuild count, index size/bytes and the per-index
+    CompileWatch — into gauges. Weakref'd + self-removing like the other
+    absorbers; the endpoint's hot-path counters (retrieval_queries,
+    retrieval_query_ms) are live registry instruments already. The gauge
+    names are process-wide: with SEVERAL live index endpoints the
+    last-registered one wins per scrape (the ``absorb_inference_stats``
+    caveat — one headline index per serving process is the deployment
+    shape; a multi-index tier wanting per-index scrape granularity reads
+    ``GET /v1/indexes`` stats instead)."""
+    ref = weakref.ref(ep)
+
+    def _cb(reg: MetricsRegistry):
+        live = ref()
+        if live is None:
+            reg.unregister_callback(_cb)
+            return
+        st = live.stats()
+        reg.gauge("retrieval_queries_served", unit="requests",
+                  help="vector queries answered by the retrieval endpoint"
+                  ).set(st["queries_served"])
+        reg.gauge("retrieval_batches_dispatched", unit="batches",
+                  help="coalesced device dispatches by the retrieval "
+                       "endpoint").set(st["batches_dispatched"])
+        reg.gauge("retrieval_queue_rejected", unit="requests",
+                  help="queries shed by the bounded retrieval admission "
+                       "queue (QueueFullError -> 429)"
+                  ).set(st["queue"]["rejected"])
+        reg.gauge("retrieval_deadline_evictions", unit="requests",
+                  help="queries evicted at batch formation because their "
+                       "deadline expired before dispatch (504)"
+                  ).set(st["queue"]["expired"])
+        reg.gauge("retrieval_index_swaps", unit="swaps",
+                  help="hot-swap index rebuilds applied under load"
+                  ).set(st["swaps"])
+        ix = st["index"]
+        reg.gauge("retrieval_index_vectors", unit="vectors",
+                  help="vectors resident in the served index"
+                  ).set(ix["size"])
+        reg.gauge("retrieval_index_bytes", unit="bytes",
+                  help="device-resident bytes of the served index "
+                       "(int8 compression shows up here)"
+                  ).set(ix["nbytes"])
+        reg.gauge("retrieval_index_compiles", unit="compiles",
+                  help="XLA compiles triggered by the served index's "
+                       "scoring kernels (should be flat after warmup)"
+                  ).set(ix["compile_watch"]["compiles"])
+
+    registry.register_callback(_cb)
+    return _cb
+
+
 def absorb_model_server(registry: MetricsRegistry, server):
     """Register a collect-time callback pulling a ``serving.ModelServer``'s
     drain state and per-endpoint breaker aggregates into gauges. Weakref'd

@@ -1,0 +1,33 @@
+"""retrieval/ — TPU-native vector retrieval: device-batched top-k over a
+resident corpus (brute force), an IVF coarse index over KMeans cells,
+int8-compressed tables on quant/'s symmetric grid, recall gates in the
+PTQ-accuracy-gate shape, builders for every embedding source the repo
+produces, and a batched serving endpoint riding the full ModelServer
+contract (`/v1/indexes/<name>:query`).
+
+    from deeplearning4j_tpu import retrieval
+    ix = retrieval.IVFIndex(vectors, int8=True)
+    retrieval.assert_recall_within(ix, queries, k=10, min_recall=0.95)
+    server.add_index("words", ix)         # serving.ModelServer
+
+See README "Vector retrieval".
+"""
+
+from deeplearning4j_tpu.retrieval.index import (  # noqa: F401
+    BruteForceIndex, IVFIndex, load_index)
+from deeplearning4j_tpu.retrieval.gates import (  # noqa: F401
+    RecallGateError, assert_recall_within, recall_at_k, recall_delta)
+from deeplearning4j_tpu.retrieval.build import (  # noqa: F401
+    build_index, synthetic_corpus, vectors_from_graph,
+    vectors_from_model, vectors_from_word2vec)
+from deeplearning4j_tpu.retrieval.service import (  # noqa: F401
+    IndexDispatchError, IndexEndpoint)
+
+__all__ = [
+    "BruteForceIndex", "IVFIndex", "load_index",
+    "RecallGateError", "assert_recall_within", "recall_at_k",
+    "recall_delta",
+    "build_index", "synthetic_corpus", "vectors_from_word2vec",
+    "vectors_from_graph", "vectors_from_model",
+    "IndexEndpoint", "IndexDispatchError",
+]

@@ -1,0 +1,124 @@
+"""Index construction from any embedding source the repo produces.
+
+The retrieval tier is only useful if every embedding producer can feed
+it; these builders normalize the three families into ``(labels, matrix)``
+and hand them to an index class:
+
+- **Word2Vec / SequenceVectors / GloVe** (``nlp/``) — the trained lookup
+  table (``get_word_vector_matrix``) with vocab words as labels, row i
+  per vocab index i.
+- **DeepWalk / Node2Vec** (``graphs/``) — per-vertex embeddings, labels
+  are the vertex ids (rows ordered by vertex).
+- **Any network's penultimate layer** (``nn/``) — ``feed_forward``
+  activations of the layer below the output head over a corpus of
+  inputs, chunked so the activation matrix never exceeds one chunk of
+  host memory. The classic "CNN features as a visual search index".
+
+``build_index(source, kind="brute"|"ivf", ...)`` dispatches on source
+type; pass a plain ``(n, d)`` array to skip the sniffing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.retrieval.index import BruteForceIndex, IVFIndex
+
+__all__ = ["vectors_from_word2vec", "vectors_from_graph",
+           "vectors_from_model", "build_index", "synthetic_corpus"]
+
+
+def synthetic_corpus(n: int, d: int, *, n_clusters: Optional[int] = None,
+                     spread: float = 0.5, seed: int = 0,
+                     queries: int = 0):
+    """Seeded clustered corpus for smoke tests, benches and demos —
+    real embeddings cluster, so uniform noise is the IVF-adversarial
+    case, not the deployed one. Returns a float32 ``(n, d)`` matrix, or
+    ``(V, Q)`` when ``queries`` > 0 (queries drawn from the same
+    mixture). ONE recipe shared by bench_retrieval, the CLI's
+    ``random:`` source and the tier-1 gates, so they all measure the
+    same distribution."""
+    rng = np.random.default_rng(seed)
+    nc = max(16, n // 100) if n_clusters is None else int(n_clusters)
+    means = rng.standard_normal((nc, d)).astype(np.float32) * 2.0
+    V = (means[rng.integers(0, nc, n)]
+         + rng.standard_normal((n, d)).astype(np.float32) * spread)
+    if not queries:
+        return V
+    Q = (means[rng.integers(0, nc, queries)]
+         + rng.standard_normal((queries, d)).astype(np.float32) * spread)
+    return V, Q
+
+
+def vectors_from_word2vec(vectors) -> Tuple[list, np.ndarray]:
+    """(words, matrix) from a trained ``SequenceVectors`` family model —
+    row i is the vector of vocab word i, so the index's result ids ARE
+    vocab indexes and ``labels`` carries the words."""
+    if getattr(vectors, "vocab", None) is None \
+            or getattr(vectors, "syn0", None) is None:
+        raise ValueError("embedding model is not fitted (no vocab/table)")
+    words = vectors.vocab.words()
+    mat = np.asarray(vectors.get_word_vector_matrix(), np.float32)
+    # subclasses may append non-word rows (doc vectors); index only the
+    # rows that answer as words
+    return list(words), mat[:len(words)]
+
+
+def vectors_from_graph(graph_vectors) -> Tuple[list, np.ndarray]:
+    """(vertex-id labels, matrix) from a fitted DeepWalk/Node2Vec — rows
+    ordered by vertex id, so result i is vertex i."""
+    n = getattr(graph_vectors, "num_vertices", 0)
+    if not n:
+        raise ValueError("graph embedding model is not fitted")
+    rows = [np.asarray(graph_vectors.get_vertex_vector(v), np.float32)
+            for v in range(n)]
+    return [str(v) for v in range(n)], np.stack(rows)
+
+
+def vectors_from_model(net, inputs, layer: int = -2,
+                       chunk: int = 1024) -> np.ndarray:
+    """Penultimate-layer (default) activation matrix over ``inputs`` —
+    the embedding a trained classifier gives away for free. ``layer``
+    indexes ``feed_forward``'s activation list (-1 is the output head);
+    activations flatten to (n, features). Chunked so the host never
+    holds more than one chunk of full activation stacks."""
+    x = np.asarray(inputs, np.float32)
+    out = []
+    for lo in range(0, len(x), int(chunk)):
+        acts = net.feed_forward(x[lo:lo + int(chunk)])
+        a = np.asarray(acts[layer], np.float32)
+        out.append(a.reshape(a.shape[0], -1))
+    return np.concatenate(out, axis=0)
+
+
+def build_index(source, kind: str = "brute", *,
+                inputs=None, layer: int = -2,
+                labels: Optional[Sequence[str]] = None, **index_kwargs):
+    """One constructor for every source:
+
+    - ``(n, d)`` array → indexed as-is (``labels=`` passes through);
+    - Word2Vec/SequenceVectors/GloVe → vocab table, word labels;
+    - DeepWalk/Node2Vec → vertex table, vertex-id labels;
+    - a network + ``inputs=`` corpus → penultimate activations
+      (``layer=`` picks another tap).
+
+    ``kind`` is ``"brute"`` (exact) or ``"ivf"``; everything else
+    (``int8=``, ``nprobe=``, ``metric=`` …) forwards to the index."""
+    if kind not in ("brute", "ivf"):
+        raise ValueError(f"unknown index kind {kind!r} "
+                         "(known: 'brute', 'ivf')")
+    if hasattr(source, "get_word_vector_matrix"):
+        labels, mat = vectors_from_word2vec(source)
+    elif hasattr(source, "get_vertex_vector"):
+        labels, mat = vectors_from_graph(source)
+    elif hasattr(source, "feed_forward"):
+        if inputs is None:
+            raise ValueError("indexing a network's activations needs "
+                             "inputs= (the corpus to embed)")
+        mat = vectors_from_model(source, inputs, layer=layer)
+    else:
+        mat = np.asarray(source, np.float32)
+    cls = BruteForceIndex if kind == "brute" else IVFIndex
+    return cls(mat, labels=labels, **index_kwargs)

@@ -1,0 +1,120 @@
+"""Recall gates: does the approximate/compressed index still answer like
+exact brute force?
+
+The quant/ subsystem ships its int8 lowering behind an accuracy gate
+(``assert_accuracy_within``); retrieval ships its approximations behind
+the same kind of gate, with recall@k as the metric:
+
+- ``recall_at_k(index, queries, k)`` — fraction of the exact top-k (a
+  float32 :class:`~deeplearning4j_tpu.retrieval.index.BruteForceIndex`
+  built over the same corpus, or a caller-supplied one) that the index
+  returns, averaged over queries. IVF loses recall to unprobed cells,
+  int8 to grid rounding; both are measured the same way.
+- ``recall_delta(a, b, queries, k)`` — paired report for "did int8 cost
+  recall over its float source" questions (the PTQ delta shape).
+- ``assert_recall_within(...)`` — the gate: minimum absolute recall
+  and/or maximum delta vs a baseline index; raises
+  :class:`RecallGateError` with the measured numbers when violated. The
+  tier-1 retrieval tests gate the default IVF config at recall@10 ≥ 0.95
+  and the int8 indexes at delta ≤ 0.01 on a seeded corpus.
+
+The measured recall lands in the obs registry as ``retrieval_recall``
+(per index kind) so rollout automation can scrape the number the tests
+gate on — the ``quant_accuracy_delta`` precedent.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["recall_at_k", "recall_delta", "assert_recall_within",
+           "RecallGateError"]
+
+
+class RecallGateError(AssertionError):
+    """An index fell outside its stated recall budget."""
+
+
+def _exact_for(index, queries, k: int) -> np.ndarray:
+    from deeplearning4j_tpu.retrieval.index import BruteForceIndex
+
+    # the exact reference scores the index's own stored float corpus when
+    # it has one; int8 indexes need the caller to pass the float exact
+    # (their stored table is already rounded)
+    if index.int8:
+        raise ValueError(
+            "recall of an int8 index needs an explicit float32 exact "
+            "reference — pass exact=BruteForceIndex(original_vectors)")
+    if isinstance(index, BruteForceIndex):
+        return index.search(queries, k)[0]
+    vecs = None
+    ids = np.asarray(index._ids)
+    order = np.argsort(ids[ids >= 0])
+    cells = np.asarray(index._cells).reshape(-1, index.dim)
+    vecs = cells[ids.reshape(-1) >= 0][order]
+    return BruteForceIndex(vecs, metric=index.metric).search(queries, k)[0]
+
+
+def recall_at_k(index, queries, k: int = 10, *, exact=None) -> float:
+    """Mean fraction of the exact top-k recovered per query. ``exact`` is
+    a BruteForceIndex over the same (float32) corpus, a precomputed
+    (b, k) exact-indices array, or None to derive one from the index's
+    own stored float vectors."""
+    q = np.atleast_2d(np.asarray(queries, np.float32))
+    got, _ = index.search(q, k)
+    if exact is None:
+        want = _exact_for(index, q, k)
+    elif isinstance(exact, np.ndarray):
+        want = exact[:, :k]
+    else:
+        want = exact.search(q, k)[0]
+    hits = sum(len(np.intersect1d(g, w)) for g, w in zip(got, want))
+    recall = hits / float(want.shape[0] * k)
+    from deeplearning4j_tpu.obs.registry import get_registry
+    kind = index.kind + ("_int8" if index.int8 else "")
+    get_registry().gauge(
+        f"retrieval_recall_{kind}", unit="fraction",
+        help="last measured recall@k of this index kind against exact "
+             "brute force (the gate metric)").set(recall)
+    return recall
+
+
+def recall_delta(a, b, queries, k: int = 10, *, exact=None) -> dict:
+    """Paired recall report: ``a`` (e.g. an int8 index) vs ``b`` (its
+    float source), both against the same exact reference."""
+    ra = recall_at_k(a, queries, k, exact=exact)
+    rb = recall_at_k(b, queries, k, exact=exact)
+    return {"recall_a": ra, "recall_b": rb, "delta": rb - ra, "k": k}
+
+
+def assert_recall_within(index, queries, k: int = 10, *,
+                         min_recall: Optional[float] = None,
+                         baseline=None, max_delta: Optional[float] = None,
+                         exact=None) -> dict:
+    """The gate. ``min_recall`` bounds absolute recall@k; ``baseline`` +
+    ``max_delta`` bound the recall lost vs another index over the same
+    corpus (the int8-vs-float contract). Returns the measured report;
+    raises :class:`RecallGateError` outside budget."""
+    if min_recall is None and (baseline is None or max_delta is None):
+        raise ValueError("state a budget: min_recall=, or baseline= with "
+                         "max_delta=")
+    report = {"k": k}
+    r = recall_at_k(index, queries, k, exact=exact)
+    report["recall"] = r
+    if min_recall is not None and r < min_recall:
+        raise RecallGateError(
+            f"recall@{k} = {r:.4f} below the stated floor {min_recall} "
+            f"for {index.kind}{'+int8' if index.int8 else ''} — raise "
+            "nprobe/n_cells (IVF) or use a finer observer (int8), or "
+            "relax the budget deliberately")
+    if baseline is not None and max_delta is not None:
+        rb = recall_at_k(baseline, queries, k, exact=exact)
+        report["baseline_recall"] = rb
+        report["delta"] = rb - r
+        if rb - r > max_delta:
+            raise RecallGateError(
+                f"recall@{k} dropped {rb - r:.4f} vs baseline "
+                f"({rb:.4f} -> {r:.4f}), over the {max_delta} budget")
+    return report

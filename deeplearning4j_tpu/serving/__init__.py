@@ -18,3 +18,7 @@ from deeplearning4j_tpu.serving.server import (  # noqa: F401
     ModelEndpoint,
     ModelServer,
 )
+from deeplearning4j_tpu.serving.wire import (  # noqa: F401
+    decode_array,
+    encode_array,
+)

@@ -69,7 +69,6 @@ draining; 504 deadline expired — all errors are structured JSON with an
 
 from __future__ import annotations
 
-import base64
 import json
 import logging
 import math
@@ -85,6 +84,7 @@ from deeplearning4j_tpu.parallel.inference import (DeadlineExpiredError,
                                                    ParallelInference,
                                                    QueueFullError)
 from deeplearning4j_tpu.serving.breaker import CircuitBreaker
+from deeplearning4j_tpu.serving.wire import decode_array, encode_array
 from deeplearning4j_tpu.utils.http import parse_content_length
 
 log = logging.getLogger(__name__)
@@ -212,45 +212,22 @@ class ModelEndpoint:
         }
 
 
-_WIRE_DTYPES = ("float32", "float64", "int8")
-
-
 def _decode_inputs(body: dict, ep: "ModelEndpoint") -> np.ndarray:
     """Predict-body tensor decode: JSON ``inputs`` float lists, or the
-    binary wire format ``{"x_b64", "dtype", "shape"}`` (base64 of raw
-    little-endian array bytes). int8 payloads are only meaningful on a
-    quantized endpoint, where they are decoded on the model's calibrated
-    input grid. Raises KeyError (no tensor at all) or ValueError (malformed)
-    — the HTTP layer maps both to 400."""
+    binary wire format ``{"x_b64", "dtype", "shape"}`` (serving/wire.py —
+    base64 of raw little-endian array bytes). int8 payloads are only
+    meaningful on a quantized endpoint, where they are decoded on the
+    model's calibrated input grid. Raises KeyError (no tensor at all) or
+    ValueError (malformed) — the HTTP layer maps both to 400."""
     if "inputs" in body:
         return np.asarray(body["inputs"], dtype=np.float32)
     if "x_b64" not in body:
         raise KeyError("inputs")
-    dtype = str(body.get("dtype", "float32"))
-    if dtype not in _WIRE_DTYPES:
-        raise ValueError(f"unsupported wire dtype '{dtype}' "
-                         f"(supported: {list(_WIRE_DTYPES)})")
-    shape = body.get("shape")
-    if (not isinstance(shape, (list, tuple)) or not shape
-            or not all(isinstance(d, int) and d > 0 for d in shape)):
-        raise ValueError("binary payloads need 'shape': a non-empty list "
-                         "of positive ints")
-    raw = base64.b64decode(str(body["x_b64"]), validate=True)
-    dt = np.dtype(dtype).newbyteorder("<")
-    expected = int(np.prod(shape)) * dt.itemsize
-    if len(raw) != expected:
-        raise ValueError(
-            f"payload is {len(raw)} bytes but shape {list(shape)} of "
-            f"{dtype} needs {expected}")
-    arr = np.frombuffer(raw, dtype=dt).reshape(shape)
-    if dtype == "int8":
-        if ep.input_scale is None:
-            raise ValueError(
-                f"model '{ep.name}' is not quantized (or its first layer "
-                "is not) — int8 payloads need the endpoint's calibrated "
-                "input scale; send float32")
-        return arr.astype(np.float32) * np.float32(ep.input_scale)
-    return np.ascontiguousarray(arr, dtype=np.float32)
+    return decode_array(
+        body, int8_scale=ep.input_scale, allow_explicit_scale=False,
+        int8_hint=f"model '{ep.name}' is not quantized (or its first "
+                  "layer is not) — int8 payloads need the endpoint's "
+                  "calibrated input scale; send float32")
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -292,7 +269,8 @@ class _Handler(BaseHTTPRequestHandler):
         path = urlparse(self.path).path
         if path == "/healthz":
             self._json({"ok": True, "draining": srv.draining,
-                        "models": sorted(srv.endpoints)})
+                        "models": sorted(srv.endpoints),
+                        "indexes": sorted(srv.indexes)})
         elif path == "/readyz":
             ready, reasons = srv.readiness()
             if ready:
@@ -306,6 +284,9 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/v1/models":
             self._json({"models": {n: ep.stats()
                                    for n, ep in srv.endpoints.items()}})
+        elif path == "/v1/indexes":
+            self._json({"indexes": {n: ep.stats()
+                                    for n, ep in srv.indexes.items()}})
         elif path.startswith("/v1/models/"):
             name = path[len("/v1/models/"):]
             ep = srv.endpoints.get(name)
@@ -313,6 +294,13 @@ class _Handler(BaseHTTPRequestHandler):
                 self._error(404, "unknown_model", f"no model '{name}'")
             else:
                 self._json({"model": name, **ep.stats()})
+        elif path.startswith("/v1/indexes/"):
+            name = path[len("/v1/indexes/"):]
+            ep = srv.indexes.get(name)
+            if ep is None:
+                self._error(404, "unknown_index", f"no index '{name}'")
+            else:
+                self._json({"index": name, **ep.stats()})
         else:
             self._error(404, "not_found", "not found")
 
@@ -320,9 +308,14 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         srv = type(self).server_ref
         path = urlparse(self.path).path
-        if not (path.startswith("/v1/models/") and path.endswith(":predict")):
+        if path.startswith("/v1/models/") and path.endswith(":predict"):
+            self._do_predict(srv, path)
+        elif path.startswith("/v1/indexes/") and path.endswith(":query"):
+            self._do_query(srv, path)
+        else:
             self._error(404, "not_found", "not found")
-            return
+
+    def _do_predict(self, srv, path):
         name = path[len("/v1/models/"):-len(":predict")]
         ep = srv.endpoints.get(name)
         if ep is None:
@@ -406,6 +399,135 @@ class _Handler(BaseHTTPRequestHandler):
         finally:
             srv._exit_request()
 
+    def _do_query(self, srv, path):
+        """``POST /v1/indexes/<name>:query`` — batched vector k-NN with
+        the full serving contract (429 shed / 503 breaker / 504 deadline
+        / drain), sharing the admission gate and SLO metrics with the
+        predict route. Queries arrive as JSON ``{"queries": [[...]]}`` or
+        the binary wire form ``{"x_b64","dtype","shape"}`` (int8 decoded
+        on the index's table grid, or an explicit ``"scale"``); pass
+        ``"b64": true`` to get ``indices_b64``/``distances_b64`` binary
+        responses back."""
+        from deeplearning4j_tpu.parallel.inference import \
+            DeadlineExpiredError as _Expired
+        from deeplearning4j_tpu.retrieval.service import IndexDispatchError
+
+        name = path[len("/v1/indexes/"):-len(":query")]
+        ep = srv.indexes.get(name)
+        if ep is None:
+            self._error(404, "unknown_index", f"no index '{name}'")
+            return
+        length, err = parse_content_length(self.headers, srv.max_body_bytes)
+        if err is not None:
+            code, message = err
+            self._error(code, "bad_request" if code == 400
+                        else "body_too_large", message)
+            return
+        srv._m_requests.inc()
+        if not srv._enter_request():
+            srv._m_drain_rejected.inc()
+            self._error(503, "draining",
+                        "server is draining; retry against another replica",
+                        retry_after_s=srv.retry_after_s)
+            return
+        t0 = time.perf_counter()
+        try:
+            try:
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+                if "queries" in body:
+                    q = np.asarray(body["queries"], dtype=np.float32)
+                elif "x_b64" in body:
+                    ix = ep.index
+                    scale = ix.scale if ix.int8 else None
+                    q = decode_array(
+                        body, int8_scale=(float(body["scale"])
+                                          if "scale" in body else scale),
+                        int8_hint=f"index '{name}' is not int8-quantized "
+                                  "— int8 query payloads need a 'scale' "
+                                  "field (or an int8 index, whose table "
+                                  "grid is used); send float32")
+                else:
+                    raise ValueError(
+                        "body needs a 'queries' array ({\"queries\": "
+                        "[[...], ...]}) or the binary form "
+                        "{\"x_b64\", \"dtype\", \"shape\"}")
+                if q.ndim == 1:
+                    q = q[None, :]
+                k = int(body.get("k", ep.k_default))
+                deadline_ms = body.get(
+                    "deadline_ms", self.headers.get("X-Deadline-Ms"))
+                if deadline_ms is not None:
+                    deadline_ms = float(deadline_ms)
+                if q.ndim != 2 or q.shape[0] < 1 \
+                        or q.shape[1] != ep.index.dim:
+                    raise ValueError(
+                        f"index '{name}' takes (b, {ep.index.dim}) "
+                        f"queries; got shape {tuple(q.shape)}")
+                if not 1 <= k <= ep.k_max:
+                    raise ValueError(
+                        f"k must be in [1, {ep.k_max}]; got {k}")
+                if q.shape[0] > ep.max_query_rows:
+                    raise ValueError(
+                        f"batch of {q.shape[0]} queries exceeds this "
+                        f"endpoint's max_query_rows={ep.max_query_rows}; "
+                        "split the batch")
+            except (ValueError, TypeError, KeyError) as e:
+                self._error(400, "bad_request", f"malformed request: {e}")
+                return
+            try:
+                idx, dist = ep.query(q, k, deadline_ms=deadline_ms)
+            except QueueFullError as e:
+                srv._m_shed.inc()
+                self._error(429, "shed", str(e),
+                            retry_after_s=srv.retry_after_s)
+                return
+            except BreakerOpenError as e:
+                srv._m_breaker_rejected.inc()
+                self._error(503, "breaker_open",
+                            f"index '{name}' is failing; breaker open",
+                            retry_after_s=e.retry_after_s)
+                return
+            except _Expired as e:
+                srv._m_expired.inc()
+                srv._m_request_ms.observe((time.perf_counter() - t0) * 1e3)
+                self._error(504, "deadline_expired", str(e))
+                return
+            except IndexDispatchError as e:
+                srv._m_errors.inc()
+                self._error(500, "dispatch_failed", f"query failed: {e}")
+                return
+            except ValueError as e:
+                # admission-time validation (shape/k/rows drift between
+                # the HTTP checks and submit, e.g. across a hot-swap):
+                # still a caller error — 400, never a dead handler
+                self._error(400, "bad_request", f"malformed request: {e}")
+                return
+            srv._m_request_ms.observe((time.perf_counter() - t0) * 1e3)
+            srv._m_requests_retrieval.inc()
+            out = {"index": name, "k": k}
+            labels = ep.index.labels
+            if body.get("b64"):
+                # fixed response dtypes: indices int32 LE, distances
+                # float32 LE, both of the stated shape
+                out["indices_b64"] = encode_array(
+                    np.asarray(idx, np.int32), "indices_b64")["indices_b64"]
+                out["distances_b64"] = encode_array(
+                    np.asarray(dist, np.float32),
+                    "distances_b64")["distances_b64"]
+                out["shape"] = [int(s) for s in np.asarray(idx).shape]
+            else:
+                out["indices"] = np.asarray(idx).tolist()
+                out["distances"] = np.asarray(dist).tolist()
+                if labels is not None:
+                    out["labels"] = [[labels[i] if 0 <= i < len(labels)
+                                      else None for i in row]
+                                     for row in np.asarray(idx)]
+            self._json(out)
+        finally:
+            srv._exit_request()
+
 
 class ModelServer:
     """Multi-model HTTP serving front (see module docstring).
@@ -432,6 +554,7 @@ class ModelServer:
         self._default_queue_depth = int(queue_depth)
         self._default_batch_limit = int(batch_limit)
         self.endpoints: Dict[str, ModelEndpoint] = {}
+        self.indexes: Dict[str, object] = {}  # name -> IndexEndpoint
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._warmup_thread: Optional[threading.Thread] = None
@@ -462,6 +585,9 @@ class ModelServer:
         self._m_errors = reg.counter(
             "serving_request_errors", unit="requests",
             help="predict requests that failed in model dispatch (500)")
+        self._m_requests_retrieval = reg.counter(
+            "serving_retrieval_requests", unit="requests",
+            help="retrieval :query requests answered 200 over HTTP")
         self._m_request_ms = reg.histogram(
             "serving_request_ms", unit="ms",
             help="end-to-end HTTP predict latency for admitted requests "
@@ -535,6 +661,41 @@ class ModelServer:
         self.endpoints[name] = ep
         return ep
 
+    def add_index(self, name: str, index, *, k_default: int = 10,
+                  k_max: int = 128,
+                  default_deadline_ms: Optional[float] = None,
+                  queue_depth: Optional[int] = None,
+                  batch_limit: int = 64,
+                  breaker: Optional[CircuitBreaker] = None,
+                  warmup_queries: int = 256):
+        """Register a vector index (``retrieval/``) behind
+        ``POST /v1/indexes/<name>:query`` with the SAME serving contract
+        as models: bounded admission (429), per-request deadlines (504),
+        circuit breaker (503), drain, warmup-gated readiness and the SLO
+        metrics. Pass a ``retrieval.IndexEndpoint`` to control batching
+        yourself, or any index (BruteForceIndex/IVFIndex) for the
+        defaults. Hot-swap a rebuilt index under load via the returned
+        endpoint's ``swap_index()``."""
+        from deeplearning4j_tpu.retrieval.service import IndexEndpoint
+
+        if name in self.indexes:
+            raise ValueError(f"index '{name}' already registered")
+        if isinstance(index, IndexEndpoint):
+            ep = index
+            ep.name = name
+        else:
+            ep = IndexEndpoint(
+                name, index, k_default=k_default, k_max=k_max,
+                default_deadline_ms=(self.default_deadline_ms
+                                     if default_deadline_ms is None
+                                     else default_deadline_ms),
+                queue_depth=(self._default_queue_depth if queue_depth is None
+                             else queue_depth),
+                batch_limit=batch_limit, breaker=breaker,
+                warmup_queries=warmup_queries)
+        self.indexes[name] = ep
+        return ep
+
     # ------------------------------------------------------------ lifecycle
     def start(self, warmup: bool = True,
               warmup_async: bool = True) -> "ModelServer":
@@ -568,21 +729,26 @@ class ModelServer:
         return self
 
     def warmup(self):
-        """Compile every endpoint's warmup ladder (gates ``/readyz``)."""
-        for ep in list(self.endpoints.values()):
+        """Compile every endpoint's warmup ladder (gates ``/readyz``) —
+        model bucket ladders and index (bucket × k-rung) ladders alike."""
+        for ep in list(self.endpoints.values()) + list(self.indexes.values()):
             try:
                 ep.warmup()
             except Exception:
-                log.exception("warmup failed for model '%s'; endpoint "
+                log.exception("warmup failed for endpoint '%s'; it "
                               "stays not-ready", ep.name)
         return self
 
     def readiness(self):
         unwarmed = sorted(n for n, ep in self.endpoints.items()
                           if not ep.warmed)
+        unwarmed_ix = sorted(n for n, ep in self.indexes.items()
+                             if not ep.warmed)
         reasons = []
         if unwarmed:
             reasons.append(f"warmup pending: {unwarmed}")
+        if unwarmed_ix:
+            reasons.append(f"index warmup pending: {unwarmed_ix}")
         if self.draining:
             reasons.append("draining")
         return (not reasons, reasons)
@@ -642,6 +808,8 @@ class ModelServer:
         for ep in self.endpoints.values():
             if ep.owns_pi:
                 ep.pi.shutdown()
+        for iep in self.indexes.values():
+            iep.shutdown()
 
     @property
     def address(self) -> str:

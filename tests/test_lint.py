@@ -718,6 +718,66 @@ class TestCompileIntrospectionInHotPath:
         """, path=self.SERVING_PATH) == []
 
 
+class TestHostWorkInRetrieval:
+    RETRIEVAL_PATH = "deeplearning4j_tpu/retrieval/thing.py"
+
+    def test_fires_on_np_in_jitted_kernel(self):
+        vs = _lint("""
+            import functools
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def _rank_all(q, vecs, k):
+                d = jnp.matmul(q, vecs.T)
+                return np.argsort(d)
+        """, path=self.RETRIEVAL_PATH)
+        assert _rules(vs) == ["DLT013"]
+        assert "host numpy" in vs[0].message
+
+    def test_fires_on_item_and_device_get_in_score_fn(self):
+        vs = _lint("""
+            import jax
+            import jax.numpy as jnp
+            def score_cells(q, cells):
+                d = jnp.einsum("bd,cd->bc", q, cells)
+                best = d.min().item()
+                return jax.device_get(d), best
+        """, path=self.RETRIEVAL_PATH)
+        assert _rules(vs) == ["DLT013", "DLT013"]
+
+    def test_host_side_wrapper_and_builders_exempt(self):
+        # the padding wrapper around the dispatch and pure-host builders
+        # are the designed host boundary — out of scope by construction
+        assert _lint("""
+            import numpy as np
+            import jax.numpy as jnp
+            def search(self, queries, k):
+                q = np.asarray(queries, np.float32)
+                dist, idx = self._search_device(jnp.asarray(q), k)
+                return np.asarray(idx), np.asarray(dist)
+            def build_table(vecs):
+                return np.clip(np.rint(vecs), -127, 127)
+        """, path=self.RETRIEVAL_PATH) == []
+
+    def test_out_of_scope_path_clean(self):
+        assert _lint("""
+            import jax.numpy as jnp
+            import numpy as np
+            def score_stuff(x):
+                return np.asarray(jnp.abs(x))
+        """, path="deeplearning4j_tpu/perf/thing.py") == []
+
+    def test_inline_waiver(self):
+        assert _lint("""
+            import jax.numpy as jnp
+            import numpy as np
+            def probe_debug(q):
+                v = jnp.abs(q)
+                return np.asarray(v)  # lint: disable=DLT013 (debug dump)
+        """, path=self.RETRIEVAL_PATH) == []
+
+
 class TestFileWaiver:
     def test_disable_file(self):
         vs = _lint("""

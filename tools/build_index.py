@@ -1,0 +1,137 @@
+"""Offline vector-index build CLI (retrieval/ subsystem).
+
+Build a device-servable index from a vector source, gate its recall, and
+save the ``.npz`` that ``retrieval.load_index`` (and a serving replica's
+hot-swap rebuild) loads in milliseconds::
+
+    python tools/build_index.py --vectors corpus.npy --kind ivf \
+        --int8 --nprobe 8 --out words.idx.npz --gate-min-recall 0.95
+
+    # smoke-query the saved index
+    python tools/build_index.py --load words.idx.npz --query-random 4
+
+Vector sources (``--vectors``):
+
+- ``path.npy`` — a raw (n, d) float matrix
+- ``path.npz[:key]`` — an array from an .npz archive (default key ``x``)
+- ``random:<n>x<d>[@seed]`` — a synthetic clustered corpus (smoke tests)
+
+``--gate-min-recall`` runs ``retrieval.assert_recall_within`` on a held-
+out sample of the corpus itself before saving — a failed gate exits
+nonzero and writes nothing, the quant-CLI precedent: an index that lost
+too much recall never reaches serving.
+
+Serve the result through ``serving.ModelServer.add_index`` (see README
+"Vector retrieval" for the endpoint recipe and the hot-swap rebuild).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _load_vectors(spec: str) -> np.ndarray:
+    if spec.startswith("random:"):
+        from deeplearning4j_tpu.retrieval import synthetic_corpus
+        body = spec[len("random:"):]
+        seed = 0
+        if "@" in body:
+            body, s = body.rsplit("@", 1)
+            seed = int(s)
+        n, d = (int(x) for x in body.split("x"))
+        return synthetic_corpus(n, d, seed=seed)
+    path, _, key = spec.partition(":")
+    if path.endswith(".npz"):
+        with np.load(path) as z:
+            return np.asarray(z[key or "x"], np.float32)
+    return np.asarray(np.load(path), np.float32)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--vectors", help="vector source (npy/npz/random: spec)")
+    p.add_argument("--kind", choices=("brute", "ivf"), default="ivf")
+    p.add_argument("--metric", choices=("euclidean", "cosine"),
+                   default="euclidean")
+    p.add_argument("--int8", action="store_true",
+                   help="int8-compress the table (quant/ symmetric grid)")
+    p.add_argument("--observer", default="minmax",
+                   choices=("minmax", "percentile"),
+                   help="table-clip observer for --int8")
+    p.add_argument("--n-cells", type=int, default=None,
+                   help="IVF cells (default sqrt(n))")
+    p.add_argument("--nprobe", type=int, default=8)
+    p.add_argument("--seed", type=int, default=123)
+    p.add_argument("--out", help="output .npz path")
+    p.add_argument("--gate-min-recall", type=float, default=None,
+                   help="recall@--gate-k floor asserted on a held-out "
+                        "corpus sample before saving")
+    p.add_argument("--gate-k", type=int, default=10)
+    p.add_argument("--gate-queries", type=int, default=128)
+    p.add_argument("--load", help="load an existing index instead of "
+                                  "building")
+    p.add_argument("--query-random", type=int, default=0, metavar="B",
+                   help="smoke-query B random vectors and print results")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from deeplearning4j_tpu import retrieval
+
+    if args.load:
+        ix = retrieval.load_index(args.load)
+        print(json.dumps({"loaded": args.load, **{
+            k: v for k, v in ix.stats().items() if k != "compile_watch"}}))
+    else:
+        if not args.vectors:
+            print("need --vectors (or --load)", file=sys.stderr)
+            return 2
+        v = _load_vectors(args.vectors)
+        kwargs = dict(metric=args.metric, int8=args.int8,
+                      observer=args.observer)
+        if args.kind == "ivf":
+            kwargs.update(n_cells=args.n_cells, nprobe=args.nprobe,
+                          seed=args.seed)
+        ix = retrieval.build_index(v, kind=args.kind, **kwargs)
+        if args.gate_min_recall is not None:
+            rng = np.random.default_rng(args.seed)
+            q = v[rng.choice(len(v), min(args.gate_queries, len(v)),
+                             replace=False)]
+            exact = (retrieval.BruteForceIndex(v, metric=args.metric)
+                     if (args.int8 or args.kind == "ivf") else None)
+            try:
+                report = retrieval.assert_recall_within(
+                    ix, q, args.gate_k, min_recall=args.gate_min_recall,
+                    exact=exact)
+            except retrieval.RecallGateError as e:
+                print(f"recall gate FAILED: {e}", file=sys.stderr)
+                return 1
+            print(json.dumps({"recall_gate": report}))
+        st = {k: v2 for k, v2 in ix.stats().items()
+              if k != "compile_watch"}
+        print(json.dumps({"built": st}))
+        if args.out:
+            ix.save(args.out)
+            print(json.dumps({"saved": args.out,
+                              "bytes": os.path.getsize(args.out)}))
+    if args.query_random:
+        rng = np.random.default_rng(1)
+        q = rng.standard_normal((args.query_random, ix.dim)).astype(
+            np.float32)
+        idx, dist = ix.search(q, min(5, ix.size))
+        print(json.dumps({"query_smoke": {
+            "indices": np.asarray(idx).tolist(),
+            "distances": np.round(np.asarray(dist), 4).tolist()}}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
