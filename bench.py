@@ -1383,13 +1383,16 @@ def bench_data_plane():
 
 
 def bench_retrieval():
-    """Vector retrieval: device-batched QPS + recall@10 for brute / IVF /
-    int8-IVF vs the host-side VPTree, at 100k and 1M vectors (QUICK: one
-    tiny corpus). Metrics only on CPU per the 9p note; the VPTree
-    comparison is capped at 100k vectors (a million-node host tree takes
-    minutes to build and proves nothing new about the host baseline)."""
+    """Vector retrieval: device-batched QPS + recall@10 + index MB for
+    the full compression ladder — brute / IVF / int8-IVF / int4 / PQ /
+    IVF-PQ — vs the host-side VPTree, at 100k and 1M vectors (QUICK: one
+    tiny corpus, smaller PQ codebooks). Metrics only on CPU per the 9p
+    note; the VPTree comparison is capped at 100k vectors (a
+    million-node host tree takes minutes to build and proves nothing new
+    about the host baseline)."""
     from deeplearning4j_tpu.clustering.vptree import VPTree
     from deeplearning4j_tpu.retrieval import (BruteForceIndex, IVFIndex,
+                                              IVFPQIndex, PQIndex,
                                               recall_at_k,
                                               synthetic_corpus)
 
@@ -1397,6 +1400,9 @@ def bench_retrieval():
     n_queries = 64 if QUICK else 1024
     batch = 64 if QUICK else 256
     k = 10
+    # QUICK shrinks the codebooks (256-entry books on a 2k corpus spend
+    # the whole smoke budget inside KMeans for no extra signal)
+    ksub = 64 if QUICK else 256
     for n, d in sizes:
         V, Q = synthetic_corpus(n, d, n_clusters=max(16, n // 200),
                                 seed=0, queries=n_queries)
@@ -1418,6 +1424,9 @@ def bench_retrieval():
             "brute": BruteForceIndex(V),
             "ivf": IVFIndex(V),
             "ivf_int8": IVFIndex(V, int8=True),
+            "int4": BruteForceIndex(V, int4=True),
+            "pq": PQIndex(V, M=8, ksub=ksub, rerank=16),
+            "ivf_pq": IVFPQIndex(V, M=8, ksub=ksub, rerank=8),
         }
         exact = indexes["brute"]
         # host-tree baseline: per-query tree walks on one CPU thread.

@@ -123,6 +123,19 @@ Rules (waivable per line with ``# lint: disable=DLT00X`` or per file with
   codecs, the padding wrappers around the dispatch) are exempt by
   construction. Waivable inline like DLT003.
 
+- **DLT014 host-nibble-unpack-in-pack-path**: packed-code paths
+  (``quant/pack.py`` int4 nibbles, ``retrieval/pq.py`` PQ codes) earn
+  their compression by keeping the PACKED array resident and unpacking
+  with shift/mask INSIDE the jitted scorer — host-side unpacking
+  (``np.*`` on the codes, ``.item()``, ``jax.device_get``) materializes
+  the unpacked table on the host per dispatch, exactly the ×2 (int4) /
+  ×4d/M (PQ) the packing bought. Scope (the DLT009 mixed host/device
+  shape): in ``retrieval/`` and ``quant/`` files, functions whose name
+  contains ``pack``/``unpack``/``nibble``/``adc``/``pq`` that ALSO use
+  ``jnp``/``lax`` device math; pure-host packers/builders (no jnp — the
+  build-time boundary) are exempt by construction. Waivable inline like
+  DLT003.
+
 Adding a rule: write a ``_rule_xxx(tree, src, path) -> List[LintViolation]``
 function and register it in ``_RULES``; tests in ``tests/test_lint.py``
 seed a fixture violating the rule and assert it fires.
@@ -938,6 +951,67 @@ def _rule_host_work_in_retrieval(tree, src, path) -> List[LintViolation]:
     return out
 
 
+# ------------------------------------------------------------------ DLT014
+_PACK_TOKENS = ("pack", "unpack", "nibble", "adc", "pq")
+
+
+def _is_pack_path(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return "retrieval/" in p or "quant/" in p
+
+
+def _rule_host_nibble_unpack(tree, src, path) -> List[LintViolation]:
+    if not _is_pack_path(path):
+        return []
+    aliases = _import_aliases(tree)
+    out: List[LintViolation] = []
+
+    def uses_device_math(fn) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Attribute, ast.Name)):
+                q = _resolve(_dotted(node), aliases)
+                if q.startswith(("jax.numpy", "jax.lax")):
+                    return True
+        return False
+
+    def in_scope_functions():
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            name = node.name.lower()
+            if any(t in name for t in _PACK_TOKENS) \
+                    and uses_device_math(node):
+                yield node
+
+    # dedup on the CALL node (the DLT013 nested-function note)
+    seen_calls: Set[int] = set()
+    for fn in in_scope_functions():
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in seen_calls:
+                continue
+            q = _resolve(_dotted(node.func), aliases)
+            hazard = None
+            if q == "numpy" or q.startswith("numpy."):
+                hazard = f"'{q}(...)' (host numpy)"
+            elif q == "jax.device_get":
+                hazard = "'jax.device_get(...)'"
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item":
+                hazard = "'.item()'"
+            if hazard:
+                seen_calls.add(id(node))
+                out.append(LintViolation(
+                    path, node.lineno, "DLT014",
+                    f"{hazard} inside packed-code function '{fn.name}' — "
+                    "packed int4/PQ codes stay resident and unpack with "
+                    "shift/mask INSIDE the jitted scorer (quant/pack.py "
+                    "unpack_nibbles); host-side unpacking materializes "
+                    "the table the packing shrank and syncs per "
+                    "dispatch; keep the kernel in jnp (or waive inline "
+                    "for a deliberately host-side build/test helper)"))
+    return out
+
+
 # ----------------------------------------------------------------- harness
 _RULES = (
     _rule_module_level_jnp,
@@ -953,6 +1027,7 @@ _RULES = (
     _rule_unseeded_global_rng,
     _rule_compile_introspection_in_hot_path,
     _rule_host_work_in_retrieval,
+    _rule_host_nibble_unpack,
 )
 
 

@@ -492,8 +492,17 @@ def absorb_index_endpoint(registry: MetricsRegistry, ep):
                   ).set(ix["size"])
         reg.gauge("retrieval_index_bytes", unit="bytes",
                   help="device-resident bytes of the served index "
-                       "(int8 compression shows up here)"
-                  ).set(ix["nbytes"])
+                       "(memory_bytes(): the HBM residency scraped next "
+                       "to the planner's numbers — int8/int4/PQ "
+                       "compression shows up here)"
+                  ).set(ix.get("memory_bytes", ix["nbytes"]))
+        if ix.get("pq_distortion") is not None:
+            reg.gauge("retrieval_pq_distortion", unit="mse",
+                      help="mean squared PQ reconstruction error per "
+                           "vector of the served index's codebooks "
+                           "(rises when fresh embeddings drift from the "
+                           "trained codebooks — the rebuild signal)"
+                      ).set(ix["pq_distortion"])
         reg.gauge("retrieval_index_compiles", unit="compiles",
                   help="XLA compiles triggered by the served index's "
                        "scoring kernels (should be flat after warmup)"

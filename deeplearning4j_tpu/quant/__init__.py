@@ -38,3 +38,7 @@ from deeplearning4j_tpu.quant.lowering import (  # noqa: F401
 from deeplearning4j_tpu.quant.observers import (  # noqa: F401
     MinMaxObserver, PercentileObserver, make_observer,
 )
+from deeplearning4j_tpu.quant.pack import (  # noqa: F401
+    QMAX4, dequantize_int4, pack_nibbles, packed_width, quantize_int4,
+    unpack_nibbles, unpack_nibbles_host,
+)

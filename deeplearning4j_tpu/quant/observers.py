@@ -120,6 +120,26 @@ class PercentileObserver(Observer):
 OBSERVERS = {"minmax": MinMaxObserver, "percentile": PercentileObserver}
 
 
+def observe_stream(values, observer: str = "minmax",
+                   chunk: int = 65536) -> Observer:
+    """Drive an observer over a host array in chunks — the same
+    ``(min, max, pct|x|)`` stats stream the activation-calibration
+    driver feeds, reused by the int8/int4 table and weight quantizers
+    (quant/pack.py, retrieval/index.py) so every clip ceiling comes from
+    ONE recipe."""
+    import numpy as np
+
+    obs = make_observer(observer)
+    v = np.asarray(values)
+    for lo in range(0, len(v), chunk):
+        c = v[lo:lo + chunk]
+        a = np.abs(c)
+        pct = (float(a.max()) if obs.percentile >= 100.0
+               else float(np.percentile(a, obs.percentile)))
+        obs.update(float(c.min()), float(c.max()), pct)
+    return obs
+
+
 def make_observer(name: str, percentile: float = 99.99) -> Observer:
     """Observer factory for the calibrate() string API."""
     if name == "minmax":

@@ -1,0 +1,134 @@
+"""int4 packing: two nibbles per int8 byte, with in-kernel unpack.
+
+The int8 story (quant/ PTQ weights, retrieval/ tables) stops at 4x over
+float32; the next rung halves it again. An int4 value needs only a
+nibble, so two codes share one int8 byte — the RESIDENT array is packed,
+and the jitted consumer unpacks with shift/mask INSIDE the kernel
+(``unpack_nibbles`` lowers to two shifts — XLA fuses it into the gather/
+matmul that follows), so the unpacked form only ever exists as a
+transient register/tile value, never as a host array and never as a
+second device-resident copy. Lint rule DLT014 keeps host-side nibble
+unpacking out of the jit-adjacent paths.
+
+Grid discipline mirrors the int8 one (quant/observers.py): SYMMETRIC,
+zero point always 0, codes clipped to [-7, 7] (the -8 code is unused so
+negation stays exact, the QMAX=127 precedent), per-slice scales
+``s_i = amax_i / 7`` with the table-level clipping ceiling calibrated
+through the same observer machinery PTQ activation calibration uses —
+a ``percentile`` observer clips outlier rows to the bulk's amax, the
+heavy-tail recipe.
+
+Shared by BOTH consumers named in the ROADMAP leftovers:
+
+- retrieval/ int4 tables (``BruteForceIndex(int4=True)`` /
+  ``IVFIndex(int4=True)``): packed codes resident on device, unpacked
+  inside the jitted scorer next to the int8x int8->int32 dot.
+- quant/ int4 weights: ``quantize_int4`` on a per-output-channel axis IS
+  the int4 weight grid (the per-channel PTQ weight recipe one rung
+  down); ``dequantize_int4`` restores fp32 weights for the ``<=``-delta
+  accuracy gates (quant/gates.py) to judge.
+
+Packing layout: codes pair along the LAST axis — byte j holds code 2j in
+its low nibble and code 2j+1 in its high nibble; an odd last axis pads
+one zero nibble (dequantize/unpack take ``d`` and slice it back off).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.quant.observers import observe_stream
+
+__all__ = ["QMAX4", "pack_nibbles", "unpack_nibbles",
+           "unpack_nibbles_host", "packed_width", "quantize_int4",
+           "dequantize_int4"]
+
+# int4 symmetric grid: codes in [-7, 7], the -8 code unused (the QMAX=127
+# convention one rung down)
+QMAX4 = 7.0
+
+
+def packed_width(d: int) -> int:
+    """Packed last-axis width for ``d`` codes (two per byte, odd pads)."""
+    return (int(d) + 1) // 2
+
+
+def pack_nibbles(codes: np.ndarray) -> np.ndarray:
+    """Pack int4 codes (int8 array, values in [-8, 7]) two-per-byte along
+    the last axis; returns int8 of shape ``(..., ceil(d/2))``. Host-side
+    build-time helper — the inverse lives in the kernels
+    (:func:`unpack_nibbles`)."""
+    c = np.asarray(codes)
+    if c.dtype != np.int8:
+        raise ValueError(f"pack_nibbles takes int8 codes; got {c.dtype}")
+    if c.size and (c.min() < -8 or c.max() > 7):
+        raise ValueError("int4 codes out of range [-8, 7]: "
+                         f"[{c.min()}, {c.max()}]")
+    if c.shape[-1] % 2:
+        pad = [(0, 0)] * (c.ndim - 1) + [(0, 1)]
+        c = np.pad(c, pad)
+    u = c.astype(np.uint8)
+    lo = u[..., 0::2] & 0x0F
+    hi = (u[..., 1::2] & 0x0F) << 4
+    return (lo | hi).view(np.int8)
+
+
+def unpack_nibbles(packed, d: int):
+    """In-kernel unpack (pure jnp — DLT014 scope): int8 packed array
+    ``(..., ceil(d/2))`` -> sign-extended int8 codes ``(..., d)``. Two
+    shifts per nibble (left 4 + arithmetic right 4 sign-extends the low
+    nibble; arithmetic right 4 alone yields the high one); XLA fuses the
+    result into the consuming gather/dot, so the unpacked table is a
+    transient tile, not a second resident copy."""
+    lo = jnp.right_shift(jnp.left_shift(packed, 4), 4)
+    hi = jnp.right_shift(packed, 4)
+    out = jnp.stack([lo, hi], axis=-1).reshape(
+        packed.shape[:-1] + (2 * packed.shape[-1],))
+    return out[..., :d]
+
+
+def unpack_nibbles_host(packed: np.ndarray, d: int) -> np.ndarray:
+    """Host mirror of :func:`unpack_nibbles` for build-time norms and
+    tests — NOT for scoring paths (DLT014 flags nibble unpacking next to
+    jnp; keep kernels on :func:`unpack_nibbles`)."""
+    u = np.asarray(packed).view(np.uint8)
+    lo = (u << 4).astype(np.int8) >> 4
+    hi = u.view(np.int8) >> 4
+    out = np.stack([lo, hi], axis=-1).reshape(
+        packed.shape[:-1] + (2 * packed.shape[-1],))
+    return out[..., :d]
+
+
+def quantize_int4(x: np.ndarray, *, observer: str = "minmax",
+                  chunk: int = 65536
+                  ) -> Tuple[np.ndarray, np.ndarray, float]:
+    """Symmetric int4 quantization of a 2-D ``(n, d)`` matrix with
+    PER-ROW scales (rows are vectors for retrieval tables, output
+    channels for weight matrices — reshape conv kernels to
+    ``(out, -1)`` first): ``s_i = min(amax_i, ceiling) / 7`` where the
+    table-level ``ceiling`` comes from the stated observer over the whole
+    stream (the int8 ``_quantize_table`` recipe one rung down — a
+    ``percentile`` observer clips outlier rows to the bulk's amax).
+    Returns ``(packed int8 (n, ceil(d/2)), scales (n,), wire_scale)``."""
+    v = np.asarray(x, np.float32)
+    if v.ndim != 2:
+        raise ValueError(f"quantize_int4 takes (n, d); got shape {v.shape}")
+    obs = observe_stream(v, observer, chunk)
+    ceiling = max(float(obs.amax()), 1e-12)
+    row_amax = np.abs(v).max(axis=1) if len(v) else np.zeros(0)
+    amax = np.clip(row_amax, 1e-12, ceiling)
+    scales = (amax / QMAX4).astype(np.float32)
+    codes = np.clip(np.rint(v / scales[:, None]), -QMAX4, QMAX4
+                    ).astype(np.int8)
+    return pack_nibbles(codes), scales, float(ceiling / QMAX4)
+
+
+def dequantize_int4(packed: np.ndarray, scales: np.ndarray,
+                    d: int) -> np.ndarray:
+    """fp32 reconstruction of :func:`quantize_int4`'s output — what the
+    accuracy/recall gates judge."""
+    codes = unpack_nibbles_host(packed, d).astype(np.float32)
+    return codes * np.asarray(scales, np.float32)[:, None]

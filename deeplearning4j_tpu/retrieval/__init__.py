@@ -1,13 +1,17 @@
 """retrieval/ — TPU-native vector retrieval: device-batched top-k over a
-resident corpus (brute force), an IVF coarse index over KMeans cells,
-int8-compressed tables on quant/'s symmetric grid, recall gates in the
+resident corpus (brute force), an IVF coarse index over KMeans cells
+(dense or CSR cell layout), compressed tables on quant/'s symmetric
+grids (int8, packed int4) and PQ codebooks scored by ADC (flat and
+IVF-PQ over residuals, opt-in exact re-rank), recall gates in the
 PTQ-accuracy-gate shape, builders for every embedding source the repo
-produces, and a batched serving endpoint riding the full ModelServer
+produces — including a streaming two-pass build for corpora beyond host
+RAM — and a batched serving endpoint riding the full ModelServer
 contract (`/v1/indexes/<name>:query`).
 
     from deeplearning4j_tpu import retrieval
-    ix = retrieval.IVFIndex(vectors, int8=True)
-    retrieval.assert_recall_within(ix, queries, k=10, min_recall=0.95)
+    ix = retrieval.PQIndex(vectors, M=8, rerank=16)    # ~13x vs fp32
+    retrieval.assert_recall_within(ix, queries, k=10, min_recall=0.95,
+                                   exact=retrieval.BruteForceIndex(vectors))
     server.add_index("words", ix)         # serving.ModelServer
 
 See README "Vector retrieval".
@@ -15,19 +19,22 @@ See README "Vector retrieval".
 
 from deeplearning4j_tpu.retrieval.index import (  # noqa: F401
     BruteForceIndex, IVFIndex, load_index)
+from deeplearning4j_tpu.retrieval.pq import (  # noqa: F401
+    IVFPQIndex, PQCodec, PQIndex)
 from deeplearning4j_tpu.retrieval.gates import (  # noqa: F401
     RecallGateError, assert_recall_within, recall_at_k, recall_delta)
 from deeplearning4j_tpu.retrieval.build import (  # noqa: F401
-    build_index, synthetic_corpus, vectors_from_graph,
-    vectors_from_model, vectors_from_word2vec)
+    build_index, build_index_streaming, synthetic_corpus,
+    vectors_from_graph, vectors_from_model, vectors_from_word2vec)
 from deeplearning4j_tpu.retrieval.service import (  # noqa: F401
     IndexDispatchError, IndexEndpoint)
 
 __all__ = [
-    "BruteForceIndex", "IVFIndex", "load_index",
+    "BruteForceIndex", "IVFIndex", "PQIndex", "IVFPQIndex", "PQCodec",
+    "load_index",
     "RecallGateError", "assert_recall_within", "recall_at_k",
     "recall_delta",
-    "build_index", "synthetic_corpus", "vectors_from_word2vec",
-    "vectors_from_graph", "vectors_from_model",
+    "build_index", "build_index_streaming", "synthetic_corpus",
+    "vectors_from_word2vec", "vectors_from_graph", "vectors_from_model",
     "IndexEndpoint", "IndexDispatchError",
 ]

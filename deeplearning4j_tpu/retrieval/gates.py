@@ -41,19 +41,23 @@ def _exact_for(index, queries, k: int) -> np.ndarray:
     from deeplearning4j_tpu.retrieval.index import BruteForceIndex
 
     # the exact reference scores the index's own stored float corpus when
-    # it has one; int8 indexes need the caller to pass the float exact
-    # (their stored table is already rounded)
-    if index.int8:
+    # it has one; compressed tables (int8/int4/PQ codes) need the caller
+    # to pass the float exact (their stored rows are already rounded)
+    if getattr(index, "codec", "fp32") != "fp32":
         raise ValueError(
-            "recall of an int8 index needs an explicit float32 exact "
-            "reference — pass exact=BruteForceIndex(original_vectors)")
+            f"recall of a {index.codec} index needs an explicit float32 "
+            "exact reference — pass exact=BruteForceIndex("
+            "original_vectors)")
     if isinstance(index, BruteForceIndex):
         return index.search(queries, k)[0]
-    vecs = None
-    ids = np.asarray(index._ids)
-    order = np.argsort(ids[ids >= 0])
-    cells = np.asarray(index._cells).reshape(-1, index.dim)
-    vecs = cells[ids.reshape(-1) >= 0][order]
+    if getattr(index, "layout", "dense") == "csr":
+        ids = np.asarray(index._flat_ids)
+        vecs = np.asarray(index._flat)[np.argsort(ids)]
+    else:
+        ids = np.asarray(index._ids)
+        order = np.argsort(ids[ids >= 0])
+        cells = np.asarray(index._cells).reshape(-1, index.dim)
+        vecs = cells[ids.reshape(-1) >= 0][order]
     return BruteForceIndex(vecs, metric=index.metric).search(queries, k)[0]
 
 
@@ -73,7 +77,9 @@ def recall_at_k(index, queries, k: int = 10, *, exact=None) -> float:
     hits = sum(len(np.intersect1d(g, w)) for g, w in zip(got, want))
     recall = hits / float(want.shape[0] * k)
     from deeplearning4j_tpu.obs.registry import get_registry
-    kind = index.kind + ("_int8" if index.int8 else "")
+    codec = getattr(index, "codec", "fp32")
+    kind = index.kind + (f"_{codec}" if codec != "fp32"
+                         and codec not in index.kind else "")
     get_registry().gauge(
         f"retrieval_recall_{kind}", unit="fraction",
         help="last measured recall@k of this index kind against exact "
@@ -104,11 +110,19 @@ def assert_recall_within(index, queries, k: int = 10, *,
     r = recall_at_k(index, queries, k, exact=exact)
     report["recall"] = r
     if min_recall is not None and r < min_recall:
+        codec = getattr(index, "codec", "fp32")
+        tag = index.kind + (f"+{codec}" if codec != "fp32"
+                            and codec not in index.kind else "")
+        remedy = {
+            "pq": "raise M/ksub, turn on rerank=, or probe more cells "
+                  "(IVF-PQ)",
+            "int8": "raise nprobe/n_cells (IVF) or use a finer observer",
+            "int4": "turn on rerank= (the int4 grid is coarse by "
+                    "design) or step back up to int8",
+        }.get(codec, "raise nprobe/n_cells (IVF)")
         raise RecallGateError(
             f"recall@{k} = {r:.4f} below the stated floor {min_recall} "
-            f"for {index.kind}{'+int8' if index.int8 else ''} — raise "
-            "nprobe/n_cells (IVF) or use a finer observer (int8), or "
-            "relax the budget deliberately")
+            f"for {tag} — {remedy}, or relax the budget deliberately")
     if baseline is not None and max_delta is not None:
         rb = recall_at_k(baseline, queries, k, exact=exact)
         report["baseline_recall"] = rb

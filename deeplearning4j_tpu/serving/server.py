@@ -440,14 +440,19 @@ class _Handler(BaseHTTPRequestHandler):
                     q = np.asarray(body["queries"], dtype=np.float32)
                 elif "x_b64" in body:
                     ix = ep.index
-                    scale = ix.scale if ix.int8 else None
+                    # any index that PUBLISHES a wire grid (int8 and
+                    # int4 tables — queries stay on the int8 grid
+                    # regardless of table codec) decodes int8 payloads
+                    # on it; PQ/fp32 indexes publish none
+                    scale = ix.scale
                     q = decode_array(
                         body, int8_scale=(float(body["scale"])
                                           if "scale" in body else scale),
-                        int8_hint=f"index '{name}' is not int8-quantized "
-                                  "— int8 query payloads need a 'scale' "
-                                  "field (or an int8 index, whose table "
-                                  "grid is used); send float32")
+                        int8_hint=f"index '{name}' publishes no int8 "
+                                  "wire grid — int8 query payloads need "
+                                  "a 'scale' field (or an int8/int4 "
+                                  "index, whose table grid is used); "
+                                  "send float32")
                 else:
                     raise ValueError(
                         "body needs a 'queries' array ({\"queries\": "

@@ -778,6 +778,68 @@ class TestHostWorkInRetrieval:
         """, path=self.RETRIEVAL_PATH) == []
 
 
+class TestHostNibbleUnpack:
+    PACK_PATH = "deeplearning4j_tpu/quant/pack.py"
+    PQ_PATH = "deeplearning4j_tpu/retrieval/pq.py"
+
+    def test_fires_on_np_unpack_next_to_jnp(self):
+        vs = _lint("""
+            import jax.numpy as jnp
+            import numpy as np
+            def unpack_nibbles_fast(packed, d):
+                lo = (np.left_shift(packed, 4) >> 4)
+                return jnp.asarray(lo[..., :d])
+        """, path=self.PACK_PATH)
+        assert _rules(vs) == ["DLT014"]
+        assert "host numpy" in vs[0].message
+
+    def test_fires_on_item_in_adc_fn(self):
+        vs = _lint("""
+            import jax.numpy as jnp
+            def adc_accumulate(lut, codes):
+                d2 = jnp.take(lut, codes, axis=1)
+                return d2.min().item()
+        """, path=self.PQ_PATH)
+        assert _rules(vs) == ["DLT014"]
+
+    def test_fires_on_device_get_in_pq_fn(self):
+        vs = _lint("""
+            import jax
+            import jax.numpy as jnp
+            def score_pq_debug(lut):
+                return jax.device_get(jnp.sum(lut))
+        """, path=self.PQ_PATH)
+        # name matches DLT013 (score) AND DLT014 (pq) — both rules own it
+        assert "DLT014" in _rules(vs)
+
+    def test_pure_host_packer_exempt(self):
+        # the build-time boundary: packs with numpy, touches no jnp
+        assert _lint("""
+            import numpy as np
+            def pack_nibbles(codes):
+                u = codes.astype(np.uint8)
+                return ((u[..., 0::2] & 0xF) | ((u[..., 1::2] & 0xF) << 4)
+                        ).view(np.int8)
+        """, path=self.PACK_PATH) == []
+
+    def test_out_of_scope_path_clean(self):
+        assert _lint("""
+            import jax.numpy as jnp
+            import numpy as np
+            def pack_records(x):
+                return np.asarray(jnp.abs(x))
+        """, path="deeplearning4j_tpu/perf/thing.py") == []
+
+    def test_inline_waiver(self):
+        assert _lint("""
+            import jax.numpy as jnp
+            import numpy as np
+            def unpack_probe(packed):
+                v = jnp.asarray(packed)
+                return np.asarray(v)  # lint: disable=DLT014 (test helper)
+        """, path=self.PACK_PATH) == []
+
+
 class TestFileWaiver:
     def test_disable_file(self):
         vs = _lint("""

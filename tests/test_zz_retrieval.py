@@ -695,9 +695,18 @@ def test_bench_retrieval_quick_smoke():
     lines = [json.loads(l) for l in out.stdout.splitlines() if l.strip()]
     metrics = {l["metric"]: l for l in lines if "metric" in l}
     assert not any("error" in l for l in lines), lines
-    for kind in ("vptree_host", "brute", "ivf", "ivf_int8"):
+    for kind in ("vptree_host", "brute", "ivf", "ivf_int8", "int4", "pq",
+                 "ivf_pq"):
         key = f"retrieval_{kind}_2k_qps"
         assert key in metrics, sorted(metrics)
         assert metrics[key]["value"] > 0
     assert metrics["retrieval_ivf_2k_qps"]["recall_at_10"] >= 0.95
     assert metrics["retrieval_ivf_int8_2k_qps"]["recall_at_10"] >= 0.94
+    # the compression ladder: re-ranked PQ holds recall at a fraction of
+    # the bytes; packed int4 is the smallest whole-vector table
+    assert metrics["retrieval_pq_2k_qps"]["recall_at_10"] >= 0.9
+    assert metrics["retrieval_ivf_pq_2k_qps"]["recall_at_10"] >= 0.9
+    assert metrics["retrieval_pq_2k_qps"]["index_mb"] \
+        < metrics["retrieval_brute_2k_qps"]["index_mb"] / 8
+    assert metrics["retrieval_int4_2k_qps"]["index_mb"] \
+        < metrics["retrieval_brute_2k_qps"]["index_mb"] / 4

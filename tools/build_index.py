@@ -7,6 +7,13 @@ hot-swap rebuild) loads in milliseconds::
     python tools/build_index.py --vectors corpus.npy --kind ivf \
         --int8 --nprobe 8 --out words.idx.npz --gate-min-recall 0.95
 
+    # compression rungs: --int4 (packed nibbles, half the int8 code
+    # bytes), --pq M [--ksub K --rerank R] (PQ codebooks + ADC; with
+    # --kind ivf -> IVF-PQ over residuals), --csr (IVF flat cell layout,
+    # no dense padding waste); the summary prints bytes_per_vector
+    python tools/build_index.py --vectors corpus.npy --kind ivf --pq 8 \
+        --rerank 16 --out words.pq.npz --gate-min-recall 0.95
+
     # smoke-query the saved index
     python tools/build_index.py --load words.idx.npz --query-random 4
 
@@ -62,9 +69,28 @@ def build_parser() -> argparse.ArgumentParser:
                    default="euclidean")
     p.add_argument("--int8", action="store_true",
                    help="int8-compress the table (quant/ symmetric grid)")
-    p.add_argument("--observer", default="minmax",
+    p.add_argument("--int4", action="store_true",
+                   help="int4-pack the table (two nibbles per byte, "
+                        "half the int8 code bytes; quant/pack.py grid)")
+    p.add_argument("--pq", type=int, default=None, metavar="M",
+                   help="product-quantize into M subspaces (1 byte per "
+                        "subspace per vector, ADC scoring); --kind brute "
+                        "-> flat PQ, --kind ivf -> IVF-PQ over residuals")
+    p.add_argument("--ksub", type=int, default=256,
+                   help="PQ codewords per subspace (<= 256)")
+    p.add_argument("--rerank", type=int, default=0, metavar="R",
+                   help="compressed kinds: exact host-side re-rank of "
+                        "the top R*k approximate candidates against the "
+                        "fp32 table (kept host-side; recovers recall at "
+                        "high compression)")
+    p.add_argument("--csr", action="store_true",
+                   help="IVF only: CSR cell layout (flat cell-major "
+                        "rows + offsets — no dense cap-count padding "
+                        "waste on skewed cells; IVF-PQ is CSR already)")
+    p.add_argument("--observer", default=None,
                    choices=("minmax", "percentile"),
-                   help="table-clip observer for --int8")
+                   help="table-clip observer for --int8/--int4 "
+                        "(default minmax; not a PQ knob)")
     p.add_argument("--n-cells", type=int, default=None,
                    help="IVF cells (default sqrt(n))")
     p.add_argument("--nprobe", type=int, default=8)
@@ -94,19 +120,51 @@ def main(argv=None) -> int:
         if not args.vectors:
             print("need --vectors (or --load)", file=sys.stderr)
             return 2
+        if args.int8 and args.int4 or (args.pq and (args.int8 or args.int4)):
+            print("--int8/--int4/--pq are one codec knob — pick one",
+                  file=sys.stderr)
+            return 2
         v = _load_vectors(args.vectors)
-        kwargs = dict(metric=args.metric, int8=args.int8,
-                      observer=args.observer)
-        if args.kind == "ivf":
-            kwargs.update(n_cells=args.n_cells, nprobe=args.nprobe,
+        kind = args.kind
+        if args.csr and args.kind != "ivf":
+            print("--csr is an IVF cell-layout knob (--kind ivf)",
+                  file=sys.stderr)
+            return 2
+        if args.pq:
+            if args.metric != "euclidean":
+                # forward nothing silently: PQ codebooks are euclidean
+                # centroids, and a mismatched gate oracle would judge
+                # the wrong geometry
+                print("--pq indexes are euclidean-only (codebooks are "
+                      "euclidean centroids); drop --metric",
+                      file=sys.stderr)
+                return 2
+            if args.observer:
+                print("--observer is an int8/int4 clip knob; PQ "
+                      "codebooks have no observer — drop it",
+                      file=sys.stderr)
+                return 2
+            kind = "pq" if args.kind == "brute" else "ivf_pq"
+            kwargs = dict(M=args.pq, ksub=args.ksub, rerank=args.rerank,
                           seed=args.seed)
-        ix = retrieval.build_index(v, kind=args.kind, **kwargs)
+            if kind == "ivf_pq":
+                kwargs.update(n_cells=args.n_cells, nprobe=args.nprobe)
+        else:
+            kwargs = dict(metric=args.metric, int8=args.int8,
+                          int4=args.int4, rerank=args.rerank,
+                          observer=args.observer or "minmax")
+            if args.kind == "ivf":
+                kwargs.update(n_cells=args.n_cells, nprobe=args.nprobe,
+                              seed=args.seed,
+                              layout="csr" if args.csr else "dense")
+        ix = retrieval.build_index(v, kind=kind, **kwargs)
         if args.gate_min_recall is not None:
             rng = np.random.default_rng(args.seed)
             q = v[rng.choice(len(v), min(args.gate_queries, len(v)),
                              replace=False)]
             exact = (retrieval.BruteForceIndex(v, metric=args.metric)
-                     if (args.int8 or args.kind == "ivf") else None)
+                     if (kind != "brute" or args.int8 or args.int4)
+                     else None)
             try:
                 report = retrieval.assert_recall_within(
                     ix, q, args.gate_k, min_recall=args.gate_min_recall,
