@@ -77,6 +77,10 @@ NOMINAL = {
     "autotune": 1.0,            # x, tuned-vs-default step-time ratio
                                 # (>= 1 means the record's choice is at
                                 # least as fast as the default execution)
+    "pallas": 1.0,              # x, identity denominator: bench_pallas
+                                # metrics come in kernel-on/off PAIRS and
+                                # the on-arm's speedup_vs_off field is the
+                                # signal, not vs_baseline
 }
 
 
@@ -1463,6 +1467,149 @@ def bench_retrieval():
                  **extra)
 
 
+def bench_pallas():
+    """Pallas kernel on/off ablation (perf/pallas/): the hand-written
+    kernels behind the fused BN-train custom-VJP and the retrieval
+    ADC/int4 hot loops vs their XLA references. Three probes: (1) a bf16
+    residual-block BN fwd+bwd micro-step; (2) the fused ResNet50 train
+    step plus the jaxpr-derived training-activation-bytes each arm hands
+    its backward (the HBM-traffic number the BN family attacks — the
+    ~4.7 activation-set crossings of tools/PROFILE_r5.md); (3) retrieval
+    QPS for the PQ / IVF-PQ / brute-int4 indexes. Off-TPU the "on" arm
+    runs the kernels in Pallas interpret mode, so CPU numbers validate
+    the plumbing and the metric shape, not the speedup — TPU rounds
+    record the real deltas. QUICK skips the ResNet50 execution probe
+    (interpret-mode compile of ~50 gridded BN kernels buys no smoke
+    signal) but still emits both activation-byte lines."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models import ResNet50
+    from deeplearning4j_tpu.nn.conf.convolutional import fused_bn_act_train
+    from deeplearning4j_tpu.perf import pallas as _pk
+    from deeplearning4j_tpu.perf.fusion import training_activation_bytes
+    from deeplearning4j_tpu.retrieval import (BruteForceIndex, IVFPQIndex,
+                                              PQIndex, synthetic_corpus)
+
+    arms = ((False, "off"), (True, "on"))
+    mode = "interpret" if _pk.interpret() else "native"
+
+    # ---- probe 1: residual-block BN fwd+bwd micro-step (bf16) ----------
+    if QUICK:
+        n, side, c, steps = 4, 8, 32, 2
+    else:
+        n, side, c, steps = 32, 56, 128, 20
+    rng = np.random.default_rng(0)
+    z = jnp.asarray(rng.standard_normal((n, side, side, c), np.float32),
+                    jnp.bfloat16)
+    resid = jnp.asarray(rng.standard_normal((n, side, side, c), np.float32),
+                        jnp.bfloat16)
+    gamma = jnp.ones((c,), jnp.float32)
+    beta = jnp.zeros((c,), jnp.float32)
+
+    def _loss(z, gamma, beta, resid):
+        out, _, _ = fused_bn_act_train("relu", 1e-5, z, gamma, beta, resid)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    bn_ms = {}
+    for flag, tag in arms:
+        with _pk.override(enabled=flag):
+            # fresh jit per arm: kernel selection happens at trace time
+            step = jax.jit(jax.grad(_loss, argnums=(0, 1, 2, 3)))
+            jax.block_until_ready(step(z, gamma, beta, resid))
+
+            def timed():
+                sw = Stopwatch().start()
+                for _ in range(steps):
+                    grads = step(z, gamma, beta, resid)
+                jax.block_until_ready(grads)
+                return sw.stop()
+
+            bn_ms[tag] = _best_of(timed) / steps * 1e3
+        extra = {}
+        if tag == "on":
+            extra["speedup_vs_off"] = round(bn_ms["off"] / bn_ms["on"], 2)
+        emit(f"pallas_bn_block_step_ms_{tag}", bn_ms[tag], "ms", "pallas",
+             shape=[n, side, side, c], dtype="bfloat16", kernel_mode=mode,
+             note="fused BN-train fwd+bwd over a residual block via the "
+                  "fused_bn_act_train custom-VJP; on=Pallas kernels, "
+                  "off=XLA reference. " + _REPS_NOTE, **extra)
+
+    # ---- probe 2: fused ResNet50 step + activation-set bytes -----------
+    if QUICK:
+        batch, side2, warmup, steps2 = 2, 64, 1, 2
+    else:
+        batch = int(os.environ.get("BENCH_RESNET_BATCH", "128"))
+        side2, warmup, steps2 = 224, 6, 30
+    conf = _dc.replace(
+        ResNet50(num_classes=1000, input_shape=(side2, side2, 3)).conf(),
+        dtype="bfloat16").fused()
+    rn_imgs = {}
+    for flag, tag in arms:
+        with _pk.override(enabled=flag):
+            try:
+                act_bytes = int(training_activation_bytes(conf,
+                                                          minibatch=batch))
+            except Exception:
+                act_bytes = None
+            extra = {"training_activation_bytes": act_bytes}
+            if QUICK:  # jaxpr-derived bytes only; no interpret-mode compile
+                emit(f"pallas_resnet50_activation_bytes_{tag}",
+                     float(act_bytes or 0), "bytes", "pallas", batch=batch,
+                     kernel_mode=mode, note="QUICK: jaxpr-derived "
+                     "activation-set bytes only; execution probe runs on "
+                     "full (TPU) rounds.")
+                continue
+            rn_imgs[tag], _ = _bench_resnet50_once(
+                "bfloat16", batch, side2, warmup, steps2, fused=True)
+        if not QUICK:
+            if tag == "on":
+                extra["speedup_vs_off"] = round(
+                    rn_imgs["on"] / rn_imgs["off"], 2)
+            emit(f"pallas_resnet50_imgs_per_sec_{tag}", rn_imgs[tag],
+                 "imgs/sec", "pallas", batch=batch, kernel_mode=mode,
+                 note="fused ResNet50 train step, Pallas BN kernels on/off. "
+                      + _REPS_NOTE, **extra)
+
+    # ---- probe 3: retrieval ADC / int4 QPS -----------------------------
+    if QUICK:
+        n_vec, d, n_queries, batch3, ksub = 2_000, 32, 64, 64, 64
+    else:
+        n_vec, d, n_queries, batch3, ksub = 100_000, 64, 512, 128, 256
+    k = 10
+    V, Q = synthetic_corpus(n_vec, d, n_clusters=max(16, n_vec // 200),
+                            seed=0, queries=n_queries)
+    indexes = {
+        "pq": PQIndex(V, M=8, ksub=ksub),
+        "ivf_pq": IVFPQIndex(V, M=8, ksub=ksub),
+        "int4": BruteForceIndex(V, int4=True),
+    }
+    for name, ix in indexes.items():
+        qps = {}
+        for flag, tag in arms:
+            with _pk.override(enabled=flag):
+                # per-arm warmup: each _KernelSelect arm is its own jitted
+                # function, so this traces the arm actually being timed
+                ix.warmup(max_queries=batch3, ks=(k,))
+
+                def timed():
+                    sw = Stopwatch().start()
+                    for lo in range(0, n_queries, batch3):
+                        ix.search(Q[lo:lo + batch3], k)
+                    return sw.stop()  # search() fetches to host
+
+                qps[tag] = n_queries / _best_of(timed)
+            extra = {}
+            if tag == "on":
+                extra["speedup_vs_off"] = round(qps["on"] / qps["off"], 2)
+            emit(f"pallas_retrieval_{name}_qps_{tag}", qps[tag],
+                 "queries/sec", "pallas", corpus=n_vec, kernel_mode=mode,
+                 note="ADC/int4 scoring kernels on/off; identical ids "
+                      "asserted in tests/test_zz_pallas.py. " + _REPS_NOTE,
+                 **extra)
+
+
 def main():
     benches = [("lenet", bench_lenet), ("word2vec", bench_word2vec),
                ("charlstm", bench_graveslstm), ("serving", bench_serving),
@@ -1472,6 +1619,7 @@ def main():
                ("elastic", bench_elastic),
                ("data_plane", bench_data_plane),
                ("retrieval", bench_retrieval),
+               ("pallas", bench_pallas),
                ("grad_compression", bench_grad_compression),
                ("quantized_inference", bench_quantized_inference),
                ("autotune", bench_autotune),

@@ -136,6 +136,20 @@ Rules (waivable per line with ``# lint: disable=DLT00X`` or per file with
   build-time boundary) are exempt by construction. Waivable inline like
   DLT003.
 
+- **DLT015 host-work-in-pallas-kernel**: a Pallas kernel body
+  (``perf/pallas/`` functions named ``*_kernel`` or taking ``*_ref``
+  block arguments) runs per grid program on VMEM-resident blocks —
+  interpret mode on CPU will happily execute host work or unhoisted
+  Python control flow, and the bug only detonates when the TPU round
+  Mosaic-compiles the same body. Flagged: host work (``np.*`` calls,
+  ``.item()``, ``jax.device_get``), ``while`` loops, ``for`` loops over
+  anything but a static ``range(...)``, and ``if`` statements whose test
+  reads a ``*_ref`` block (data-dependent Python branching on traced
+  values — hoist to ``pl.when``/``jnp.where``, or lift the decision to a
+  static kernel parameter). Static-parameter branches (``if has_res:``)
+  and ``for m in range(M)`` unrolls are exempt by construction. Waivable
+  inline like DLT003.
+
 Adding a rule: write a ``_rule_xxx(tree, src, path) -> List[LintViolation]``
 function and register it in ``_RULES``; tests in ``tests/test_lint.py``
 seed a fixture violating the rule and assert it fires.
@@ -1012,6 +1026,98 @@ def _rule_host_nibble_unpack(tree, src, path) -> List[LintViolation]:
     return out
 
 
+# ------------------------------------------------------------------ DLT015
+def _is_pallas_path(path: str) -> bool:
+    return "perf/pallas/" in path.replace(os.sep, "/")
+
+
+def _rule_host_work_in_pallas_kernel(tree, src, path) -> List[LintViolation]:
+    if not _is_pallas_path(path):
+        return []
+    aliases = _import_aliases(tree)
+    out: List[LintViolation] = []
+
+    def _arg_names(fn) -> List[str]:
+        a = fn.args
+        names = [x.arg for x in (a.posonlyargs + a.args + a.kwonlyargs)]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        return names
+
+    def kernel_bodies():
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name.endswith("_kernel") or any(
+                    n.endswith("_ref") or n in ("refs", "ref")
+                    for n in _arg_names(node)):
+                yield node
+
+    def _ref_names(fn) -> Set[str]:
+        # block refs: *_ref parameters plus any *_ref name the body binds
+        # (the ``*refs`` tuple-unpack idiom)
+        names = {n for n in _arg_names(fn) if n.endswith("_ref")}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and node.id.endswith("_ref"):
+                names.add(node.id)
+        return names
+
+    # dedup on the offending node (the DLT013 nested-function note)
+    seen: Set[int] = set()
+    for fn in kernel_bodies():
+        refs = _ref_names(fn)
+        for node in ast.walk(fn):
+            if id(node) in seen:
+                continue
+            hazard = fix = None
+            if isinstance(node, ast.Call):
+                q = _resolve(_dotted(node.func), aliases)
+                if q == "numpy" or q.startswith("numpy."):
+                    hazard = f"'{q}(...)' (host numpy)"
+                elif q == "jax.device_get":
+                    hazard = "'jax.device_get(...)'"
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item":
+                    hazard = "'.item()'"
+                if hazard:
+                    fix = "keep the body in jnp/lax on the block refs"
+            elif isinstance(node, ast.While):
+                hazard = "'while' loop"
+                fix = ("Python loops in a kernel body unroll at trace "
+                       "time or fail to trace on traced bounds — use "
+                       "lax.fori_loop/pl.when, or hoist the bound to a "
+                       "static kernel parameter")
+            elif isinstance(node, ast.For):
+                it = node.iter
+                is_static_range = (isinstance(it, ast.Call) and _resolve(
+                    _dotted(it.func), aliases) == "range")
+                if not is_static_range:
+                    hazard = "'for' over a non-range iterable"
+                    fix = ("only static ``for m in range(...)`` unrolls "
+                           "belong in a kernel body; anything else is "
+                           "host iteration over traced values")
+            elif isinstance(node, ast.If):
+                used = {n.id for n in ast.walk(node.test)
+                        if isinstance(n, ast.Name)}
+                if used & refs:
+                    hazard = "'if' on a kernel block ref"
+                    fix = ("Python branching on traced block values "
+                           "cannot trace — use pl.when/jnp.where, or "
+                           "lift the decision to a static kernel "
+                           "parameter")
+            if hazard:
+                seen.add(id(node))
+                out.append(LintViolation(
+                    path, node.lineno, "DLT015",
+                    f"{hazard} inside Pallas kernel body '{fn.name}' — "
+                    "kernel bodies run per grid program on VMEM blocks; "
+                    "interpret mode (CPU CI) executes this happily and "
+                    "the bug detonates only when the TPU round "
+                    f"Mosaic-compiles the same body; {fix} (or waive "
+                    "inline for a deliberate exception)"))
+    return out
+
+
 # ----------------------------------------------------------------- harness
 _RULES = (
     _rule_module_level_jnp,
@@ -1028,6 +1134,7 @@ _RULES = (
     _rule_compile_introspection_in_hot_path,
     _rule_host_work_in_retrieval,
     _rule_host_nibble_unpack,
+    _rule_host_work_in_pallas_kernel,
 )
 
 

@@ -559,6 +559,13 @@ def _bn_train_stats(z):
 
 
 def _bn_act_fwd_math(act_name, eps, z, gamma, beta, res):
+    # Pallas kernel family "bn_act": one VMEM-resident stats+normalize+
+    # activation kernel when selection resolves to it, this jnp reference
+    # otherwise — take() records kernel.pallas_/.xla_ either way
+    from deeplearning4j_tpu.perf import pallas as _pk
+    from deeplearning4j_tpu.perf.pallas import bn as _pk_bn
+    if _pk.take("bn_act", _pk_bn.supported(z)):
+        return _pk_bn.bn_act_fwd(act_name, eps, z, gamma, beta, res)
     mean, var = _bn_train_stats(z)
     sdt = var.dtype
     inv = lax.rsqrt(var + jnp.asarray(eps, sdt))
@@ -594,6 +601,13 @@ def _fused_bn_act_fwd(act_name, eps, z, gamma, beta, res):
 def _fused_bn_act_bwd(act_name, eps, saved, cts):
     z, gamma, beta, res, mean, inv = saved
     dout = cts[0]  # mean/var cotangents ignored (EMA-only outputs)
+    from deeplearning4j_tpu.perf import pallas as _pk
+    from deeplearning4j_tpu.perf.pallas import bn as _pk_bn
+    if _pk.take("bn_act_bwd", _pk_bn.supported(z)):
+        dz, dgamma, dbeta, dpre = _pk_bn.bn_act_bwd(
+            act_name, eps, z, gamma, beta, res, mean, inv, dout)
+        dres = None if res is None else dpre.astype(res.dtype)
+        return (dz, dgamma, dbeta, dres)
     sdt = mean.dtype
     scale = gamma.astype(sdt) * inv
     shift = beta.astype(sdt) - mean * scale

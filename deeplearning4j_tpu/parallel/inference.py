@@ -163,6 +163,13 @@ class ParallelInference:
             if (bucket_policy is ParallelInference._DEFAULT_POLICY
                     and tuning.buckets):
                 bucket_policy = BucketPolicy(buckets=tuning.buckets)
+            if getattr(tuning, "pallas_kernels", None) is not None:
+                # the record's measured kernel-layer winner (perf/pallas):
+                # configure BEFORE the warmup below so every warmed ladder
+                # program is traced under the inherited selection — steady
+                # state then compiles nothing
+                from deeplearning4j_tpu.perf import pallas as _pk
+                _pk.configure(enabled=tuning.pallas_kernels)
         self._fold_bn = bool(fold_bn)
         self._quantize = quantize
         # read checkpoint provenance BEFORE folding/quantizing: both

@@ -105,6 +105,11 @@ class TuningRecord:
     baseline: Dict[str, float]
     candidates_searched: int
     budget_bytes: Optional[int] = None
+    # Pallas kernel-layer selection (perf/pallas): None = the knob was not
+    # searched (selection stays automatic), True/False = the measured
+    # winner — apply_tuning re-applies it process-wide, so training and
+    # serving replicas inherit the choice without re-searching
+    pallas_kernels: Optional[bool] = None
 
     def to_dict(self) -> dict:
         return {
@@ -122,6 +127,7 @@ class TuningRecord:
             "baseline": dict(self.baseline),
             "candidates_searched": self.candidates_searched,
             "budget_bytes": self.budget_bytes,
+            "pallas_kernels": self.pallas_kernels,
         }
 
     @classmethod
@@ -146,6 +152,8 @@ class TuningRecord:
             candidates_searched=int(d.get("candidates_searched", 0)),
             budget_bytes=(None if d.get("budget_bytes") is None
                           else int(d["budget_bytes"])),
+            pallas_kernels=(None if d.get("pallas_kernels") is None
+                            else bool(d["pallas_kernels"])),
         )
 
     def to_json(self) -> str:
@@ -207,6 +215,13 @@ def apply_tuning(conf, record: TuningRecord, strict: bool = True):
             targets[int(key[len("layer"):])] = pol
         else:
             targets[key] = pol
+    if record.pallas_kernels is not None:
+        # process-wide side effect, deliberately: kernel selection is a
+        # trace-time dispatch (perf/pallas), not a conf field — replicas
+        # applying this record trace every step/serving program under the
+        # measured winner
+        from deeplearning4j_tpu.perf import pallas as _pk
+        _pk.configure(enabled=record.pallas_kernels)
     return _with_remat(out, targets)
 
 
@@ -367,7 +382,7 @@ def autotune(conf, batch_sizes: Sequence[int] = (8, 16, 32),
              top_k: int = 2, reps: int = 2, flops_per_byte: float = 8.0,
              serving_rows: Optional[Sequence[int]] = None,
              max_serving_batch: Optional[int] = None,
-             augmentation=None) -> TuningRecord:
+             augmentation=None, pallas: object = "auto") -> TuningRecord:
     """Search batch size × fusion × donation (× planner remat when
     ``budget_bytes`` is given) and emit the winning :class:`TuningRecord`.
 
@@ -381,13 +396,35 @@ def autotune(conf, batch_sizes: Sequence[int] = (8, 16, 32),
     ``serving_rows`` (observed pre-pad serving row counts) learns the
     serving bucket ladder via ``BucketPolicy.from_histogram``; otherwise
     the pow2 ladder up to ``max_serving_batch`` (default: the chosen batch
-    size) is recorded."""
+    size) is recorded.
+
+    ``pallas`` adds the hand-written kernel layer (perf/pallas) as one
+    more searched knob: ``"auto"`` searches off-vs-on wherever the
+    kernels could actually serve (``perf.pallas.candidate_flags``) and
+    leaves the search space untouched elsewhere; True/False pins the arm.
+    Each arm's candidates are lowered AND wall-clocked under that
+    selection, and the measured winner lands in
+    ``TuningRecord.pallas_kernels`` for ``apply_tuning`` /
+    ``ParallelInference(tuning=...)`` to re-apply."""
+    import contextlib
+    from deeplearning4j_tpu.perf import pallas as _pk
+
     t0 = time.perf_counter()
     gauges = _autotune_gauges()
     sig = conf_signature(conf)
     batch_sizes = sorted({int(b) for b in batch_sizes})
     if not batch_sizes:
         raise ValueError("autotune needs at least one batch size")
+    if pallas == "auto":
+        pallas_flags: Tuple = _pk.candidate_flags() or (None,)
+    elif pallas is None:
+        pallas_flags = (None,)
+    else:
+        pallas_flags = (bool(pallas),)
+
+    def _pallas_ctx(flag):
+        return (contextlib.nullcontext() if flag is None
+                else _pk.override(enabled=flag))
 
     # ---- build the candidate configurations per batch size
     per_batch: Dict[int, List[Tuple[dict, object]]] = {}
@@ -435,21 +472,29 @@ def autotune(conf, batch_sizes: Sequence[int] = (8, 16, 32),
             net = _net_for(conf_c)
             net.augmentation = augmentation
             args = _abstract_step_args(conf_c, net, b)
-            cost = None
-            for donate in donation:
-                step = _make_step(net, bool(donate))
-                if cost is None:
-                    cost = _compiled_cost(step, args)
-                cand = {"batch_size": b, "donate": bool(donate),
-                        "estimate_per_example": _estimate(cost, b),
-                        "cost": cost, "conf": conf_c, "net": net,
-                        "args": args, "step": step, **meta}
-                scored.append(cand)
-                # the baseline the record documents its win against: the
-                # default execution — smallest batch, unfused, donated
-                if (baseline_est is None and b == batch_sizes[0]
-                        and not meta["fusion"] and not meta["remat"]):
-                    baseline_est = cand
+            for pflag in pallas_flags:
+                # cost is per (variant, batch, pallas arm) — the kernel
+                # selection changes the traced program; donation flags
+                # still share it (cost_analysis cannot see donation)
+                cost = None
+                for donate in donation:
+                    step = _make_step(net, bool(donate))
+                    if cost is None:
+                        with _pallas_ctx(pflag):
+                            cost = _compiled_cost(step, args)
+                    cand = {"batch_size": b, "donate": bool(donate),
+                            "estimate_per_example": _estimate(cost, b),
+                            "cost": cost, "conf": conf_c, "net": net,
+                            "args": args, "step": step, "pallas": pflag,
+                            **meta}
+                    scored.append(cand)
+                    # the baseline the record documents its win against:
+                    # the default execution — smallest batch, unfused,
+                    # donated, reference kernels
+                    if (baseline_est is None and b == batch_sizes[0]
+                            and not meta["fusion"] and not meta["remat"]
+                            and not pflag):
+                        baseline_est = cand
     if baseline_est is None:
         # budgeted/fusion-forced searches have no untuned candidate — the
         # record still documents its win, so estimate the raw conf once
@@ -465,7 +510,11 @@ def autotune(conf, batch_sizes: Sequence[int] = (8, 16, 32),
     # ---- confirmation phase: wall-clock the top_k estimates
     confirmed = []
     for cand in scored[:max(1, int(top_k))]:
-        secs = _wall_clock_step(cand["step"], cand["args"], reps)
+        # the jitted step re-traces at its first CALL (AOT lower/compile
+        # does not seed the dispatch cache), so the wall clock must run
+        # under the candidate's pallas arm too
+        with _pallas_ctx(cand["pallas"]):
+            secs = _wall_clock_step(cand["step"], cand["args"], reps)
         confirmed.append((secs / cand["batch_size"], secs, cand))
     confirmed.sort(key=lambda t: t[0])
     per_ex, secs, best = confirmed[0]
@@ -502,6 +551,7 @@ def autotune(conf, batch_sizes: Sequence[int] = (8, 16, 32),
         }),
         candidates_searched=len(scored),
         budget_bytes=budget_bytes,
+        pallas_kernels=best["pallas"],
     )
     gauges["seconds"].set(time.perf_counter() - t0)
     gauges["candidates"].set(len(scored))

@@ -1,0 +1,229 @@
+"""Hand-written Pallas kernel layer: selection, fallback and counters.
+
+BENCH_r05 pins the ResNet50 bf16 step within ~5% of the measured HBM
+bandwidth floor: conv fwd+dW+dX alone would allow 51.4% MFU, but the
+BN-train stats/normalize/residual traffic XLA refuses to fuse across
+costs ~4.7 extra full activation-set HBM crossings (tools/PROFILE_r5.md).
+This package holds the kernels that cross that line by hand — SURVEY
+L0/§7's replacement for libnd4j's C++ kernels exactly where XLA's fusion
+control runs out. Two families, each slotted behind a boundary the repo
+already parity-tests:
+
+- **bn** (:mod:`perf.pallas.bn`): fused BN-train forward/backward behind
+  the ``fused_bn_act_train`` custom-VJP interface
+  (nn/conf/convolutional.py) — VMEM-resident stats + normalize +
+  activation (+ residual add), backward recomputing x̂ from the saved
+  conv output plus O(C) mean/inv-std.
+- **adc** (:mod:`perf.pallas.adc`): the retrieval hot loop — ADC LUT
+  gather-accumulate for ``PQIndex``/``IVFPQIndex`` and the int4
+  nibble-unpack fused against the int8×int8→int32 dot for the int4
+  tables and int4 quantized weights.
+
+Selection contract (every kernel, no exceptions):
+
+1. The jnp/XLA reference implementation stays where it is and remains
+   the default. A kernel is USED only when :func:`enabled` resolves
+   true — explicitly via :func:`configure`/:func:`override`, via the
+   ``DLT_PALLAS`` env var, or automatically on a TPU backend. Anywhere
+   Pallas is unavailable or the platform is unsupported the reference
+   runs, silently and correctly.
+2. Off-TPU, a force-enabled kernel runs in Pallas **interpret mode**
+   (:func:`interpret` resolves true) — this is how CPU CI bitwise/
+   tolerance-parity-tests the kernel bodies (tests/test_zz_pallas.py).
+3. Every dispatch records which implementation served it:
+   ``kernel.pallas_<family>`` / ``kernel.xla_<family>`` CompileWatch
+   counters (``bump_active`` — landing on the owning model/index watch
+   like the attention flash-kernel choice) which ``obs``
+   ``absorb_compile_watch`` surfaces on ``/metrics``.
+4. The choice is a searchable autotuner candidate
+   (``perf.autotune.autotune(pallas=...)``) recorded in TuningRecord as
+   ``pallas_kernels`` — ``apply_tuning`` and
+   ``ParallelInference(tuning=...)`` re-apply it, so training and
+   serving replicas inherit the measured winner without re-searching —
+   and the HBM planner snapshots it per plan
+   (``MemoryPlan.kernels``).
+
+TPU-round caveat: this container is CPU-only, so the deliverable here is
+interpret-mode parity plus the candidate/fallback/observability
+plumbing; the measured activation-crossing / step-time thresholds are
+deferred to the TPU round (ROADMAP direction 2 backlog).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Callable, Dict, Optional
+
+__all__ = [
+    "FAMILIES", "available", "enabled", "interpret", "configure",
+    "override", "candidate_flags", "selection_snapshot", "take",
+    "kernel_select",
+]
+
+# Kernel families this layer provides, family -> the boundary the kernel
+# slots behind. Keys are the <family> leg of the kernel.pallas_<family> /
+# kernel.xla_<family> dispatch counters.
+FAMILIES: Dict[str, str] = {
+    "bn_act": "fused_bn_act_train forward (nn/conf/convolutional.py)",
+    "bn_act_bwd": "fused_bn_act_train backward (custom-VJP bwd rule)",
+    "adc_pq": "PQIndex flat-ADC gather-accumulate (retrieval/pq.py)",
+    "adc_ivf_pq": "IVFPQIndex per-cell-LUT gather-accumulate "
+                  "(retrieval/pq.py)",
+    "int4_dot": "int4 nibble-unpack fused against the int32 dot "
+                "(retrieval/index.py brute table, quant/lowering.py "
+                "dense weights)",
+}
+
+_UNSET = object()
+_lock = threading.Lock()
+_state = {"enabled": None, "interpret": None}  # None = resolve automatically
+_avail: Optional[bool] = None
+
+
+def available() -> bool:
+    """Is ``jax.experimental.pallas`` importable at all? (Cached; a JAX
+    build without Pallas simply never selects a kernel.)"""
+    global _avail
+    if _avail is None:
+        try:
+            from jax.experimental import pallas  # noqa: F401
+            from jax.experimental.pallas import tpu  # noqa: F401
+            _avail = True
+        except Exception:
+            _avail = False
+    return _avail
+
+
+def _backend() -> str:
+    import jax
+    try:
+        return jax.default_backend()
+    except Exception:
+        return "cpu"
+
+
+def enabled() -> bool:
+    """Resolved selection state: explicit :func:`configure` wins, then the
+    ``DLT_PALLAS`` env var (``1``/``0``), then the automatic rule — on by
+    default on a TPU backend, off everywhere else."""
+    if not available():
+        return False
+    with _lock:
+        e = _state["enabled"]
+    if e is not None:
+        return bool(e)
+    env = os.environ.get("DLT_PALLAS")
+    if env in ("0", "1"):
+        return env == "1"
+    return _backend() == "tpu"
+
+
+def interpret() -> bool:
+    """Should ``pallas_call`` run in interpret mode? Explicit setting,
+    then ``DLT_PALLAS_INTERPRET``, then automatic: interpret everywhere
+    except a real TPU backend — force-enabling kernels on CPU (tests, CI)
+    gets the interpreter, never a Mosaic compile."""
+    with _lock:
+        i = _state["interpret"]
+    if i is not None:
+        return bool(i)
+    env = os.environ.get("DLT_PALLAS_INTERPRET")
+    if env in ("0", "1"):
+        return env == "1"
+    return _backend() != "tpu"
+
+
+def configure(enabled: object = _UNSET, interpret: object = _UNSET) -> None:
+    """Set the process-wide selection knobs. ``None`` restores automatic
+    resolution; omitted arguments are left untouched. This is what
+    ``apply_tuning`` calls when a TuningRecord carries ``pallas_kernels``
+    — serving/training replicas inherit the tuned choice through it."""
+    with _lock:
+        if enabled is not _UNSET:
+            _state["enabled"] = None if enabled is None else bool(enabled)
+        if interpret is not _UNSET:
+            _state["interpret"] = (None if interpret is None
+                                   else bool(interpret))
+
+
+@contextlib.contextmanager
+def override(enabled: object = _UNSET, interpret: object = _UNSET):
+    """Scoped :func:`configure` — the parity tests and the autotuner's
+    candidate search run each arm under this."""
+    with _lock:
+        prev = dict(_state)
+    configure(enabled=enabled, interpret=interpret)
+    try:
+        yield
+    finally:
+        with _lock:
+            _state.update(prev)
+
+
+def candidate_flags() -> tuple:
+    """The autotuner's searchable arms for the pallas knob: ``(False,
+    True)`` when kernels could actually serve (available AND either a TPU
+    backend or selection already forced on — the CPU-CI case), else ``()``
+    so the default search space stays exactly what it was."""
+    if available() and (_backend() == "tpu" or enabled()):
+        return (False, True)
+    return ()
+
+
+def selection_snapshot() -> Dict[str, str]:
+    """family -> "pallas" | "xla" at this instant — what a training step
+    traced right now would run. ``plan_memory`` stamps this into each
+    ``MemoryPlan`` so a plan documents the kernel layer it assumed."""
+    impl = "pallas" if enabled() else "xla"
+    return {fam: impl for fam in FAMILIES}
+
+
+# ------------------------------------------------------------- dispatch
+def take(family: str, supported: bool = True) -> bool:
+    """One dispatch-site decision: returns True when the Pallas kernel
+    for ``family`` should serve this call (enabled AND the call shape is
+    ``supported``), recording ``kernel.pallas_<family>`` or
+    ``kernel.xla_<family>`` on the active CompileWatch either way. Called
+    at trace time for jitted bodies (the attention flash-kernel
+    precedent: one count per trace, not per step)."""
+    from deeplearning4j_tpu.perf.compile_watch import bump_active
+    use = bool(supported) and enabled()
+    bump_active(f"kernel.pallas_{family}" if use else f"kernel.xla_{family}")
+    return use
+
+
+class _KernelSelect:
+    """Callable that picks the Pallas or XLA implementation PER CALL
+    (selection config is re-read every dispatch, so a TuningRecord applied
+    after an index was built still takes effect) and exposes a combined
+    ``_cache_size`` so ``CompileWatch.wrap`` keeps exact compile counting
+    over both underlying jitted functions."""
+
+    def __init__(self, family: str, pallas_fn: Callable, xla_fn: Callable):
+        self.family = family
+        self.pallas_fn = pallas_fn
+        self.xla_fn = xla_fn
+
+    def __call__(self, *args, **kwargs):
+        if take(self.family):
+            return self.pallas_fn(*args, **kwargs)
+        return self.xla_fn(*args, **kwargs)
+
+    def _cache_size(self) -> int:
+        total = 0
+        for fn in (self.pallas_fn, self.xla_fn):
+            total += int(fn._cache_size())
+        return total
+
+
+def kernel_select(family: str, pallas_fn: Callable,
+                  xla_fn: Callable) -> _KernelSelect:
+    """The retrieval indexes' wiring point: ``compile_watch.wrap(
+    kernel_select(...), key)`` dispatches to whichever implementation the
+    current selection resolves to, with per-dispatch kernel.* counters."""
+    if family not in FAMILIES:
+        raise KeyError(f"unknown pallas kernel family {family!r} "
+                       f"(known: {sorted(FAMILIES)})")
+    return _KernelSelect(family, pallas_fn, xla_fn)

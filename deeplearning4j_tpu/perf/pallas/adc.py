@@ -1,0 +1,190 @@
+"""Retrieval hot-loop Pallas kernels: ADC gather-accumulate + int4 dot.
+
+Three kernels behind the boundaries retrieval/ already parity-tests:
+
+- :func:`score_pq` — flat ADC for ``PQIndex``: the per-query LUT is the
+  same jitted ``_adc_lut`` matmul the reference runs; the M-way
+  code-table gather-accumulate (the bandwidth-bound loop — n·M byte
+  reads feeding n·M LUT lookups) moves into a ``pallas_call`` gridded
+  over code-table tiles, accumulating in a VMEM (b, tile) f32 block.
+- :func:`score_ivf_pq` — IVF-PQ for ``IVFPQIndex``: probe, residual
+  LUT build and CSR slot arithmetic stay the reference jnp (small,
+  matmul-shaped); the per-slot fused (segment, code) flat-index
+  gather-accumulate — the loop that touches every candidate byte —
+  runs in the kernel.
+- :func:`int4_matmul` / :func:`score_brute_int4` — the int4 table dot
+  for ``BruteForceIndex(int4=True)`` (and the int4 ``QuantizedLayer``
+  lowering): nibble unpack fused IN-KERNEL against the int8×int8→int32
+  ``dot_general``, so the unpacked operand lives only as a VMEM tile.
+
+Accumulation order matches the references step for step, so flat-ADC
+distances and the int dot are BITWISE identical — top-k ids can be
+asserted equal, not merely close (tests/test_zz_pallas.py). Dense-IVF
+int4 variants (``IVFIndex(int4=True)``) stay on the XLA reference —
+their gather-then-unpack shape is already one fused XLA op; documented
+selection rule, not an oversight.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.perf import pallas as _pk
+
+__all__ = ["score_pq", "score_ivf_pq", "int4_matmul", "score_brute_int4"]
+
+
+def _nblk(n: int) -> int:
+    # tile the code table along rows when it divides cleanly; the CSR /
+    # odd-size cases take one program over the whole table
+    return 512 if (n % 512 == 0 and n > 512) else n
+
+
+# ---------------------------------------------------------------- flat ADC
+def _adc_kernel(m_count, lut_ref, codes_ref, d2_ref):
+    codes = codes_ref[...]
+    lut = lut_ref[...]
+    acc = jnp.zeros(d2_ref.shape, jnp.float32)
+    for m in range(m_count):                       # static unroll over M
+        acc = acc + jnp.take(lut[:, m, :], codes[:, m].astype(jnp.int32),
+                             axis=1)
+    d2_ref[...] = acc
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def score_pq(q, codebooks, codes, k: int):
+    """Pallas flat ADC with ``_score_pq``'s signature and bitwise its
+    distances: LUT outside (matmul), gather-accumulate inside, top-k on
+    the kernel's (b, n) output."""
+    from jax.experimental import pallas as pl
+    from deeplearning4j_tpu.retrieval.pq import _adc_lut
+
+    b = q.shape[0]
+    m_count, ksub, dsub = codebooks.shape
+    n = codes.shape[0]
+    lut = _adc_lut(q.reshape(b, m_count, dsub), codebooks)
+    nblk = _nblk(n)
+    d2 = pl.pallas_call(
+        functools.partial(_adc_kernel, m_count),
+        grid=(n // nblk,),
+        in_specs=[
+            pl.BlockSpec((b, m_count, ksub), lambda j: (0, 0, 0)),
+            pl.BlockSpec((nblk, m_count), lambda j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((b, nblk), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((b, n), jnp.float32),
+        interpret=_pk.interpret(),
+    )(lut, codes)
+    neg, idx = lax.top_k(-d2, k)
+    return jnp.sqrt(jnp.maximum(-neg, 0.0)), idx
+
+
+# ----------------------------------------------------------------- IVF-PQ
+def _ivf_adc_kernel(m_count, ksub, lut_ref, codes_ref, seg_ref, pos_ref,
+                    d2_ref):
+    seg = seg_ref[...]
+    pos = pos_ref[...]
+    lut = lut_ref[...]
+    codes = codes_ref[...]
+    b = seg.shape[0]
+    acc = jnp.zeros(seg.shape, jnp.float32)
+    for m in range(m_count):                       # static unroll over M
+        lut_m = lut[:, :, m, :].reshape(b, -1)     # (b, p·ksub)
+        code_m = codes[pos, m].astype(seg.dtype)
+        acc = acc + jnp.take_along_axis(lut_m, seg * ksub + code_m, axis=1)
+    d2_ref[...] = acc
+
+
+@functools.partial(jax.jit, static_argnames=("k", "nprobe", "cand_pad"))
+def score_ivf_pq(q, centroids, codebooks, flat_codes, flat_ids, offsets,
+                 k: int, nprobe: int, cand_pad: int):
+    """Pallas IVF-PQ with ``_score_ivf_pq``'s signature: probe + per-cell
+    LUT + CSR slots in jnp (matmul-shaped, already fast), the per-slot
+    (segment, code) gather-accumulate in-kernel. One program over the
+    (b, cand_pad) slot block — the CSR flat table is gathered by
+    data-dependent row, so the TPU-round version needs a DMA-pipelined
+    rework (backlog); interpret-mode parity is the deliverable here."""
+    from jax.experimental import pallas as pl
+    from deeplearning4j_tpu.retrieval.index import (_centroid_d2,
+                                                    _csr_slots)
+    from deeplearning4j_tpu.retrieval.pq import _adc_lut
+
+    b = q.shape[0]
+    m_count, ksub, dsub = codebooks.shape
+    cd2 = _centroid_d2(q, centroids)
+    _, probe = lax.top_k(-cd2, nprobe)                    # (b, p)
+    qc = q[:, None, :] - centroids[probe]                 # (b, p, d)
+    lut = _adc_lut(qc.reshape(b * nprobe, m_count, dsub),
+                   codebooks).reshape(b, nprobe, m_count, ksub)
+    seg, pos, valid = _csr_slots(offsets, probe, cand_pad)
+    d2 = pl.pallas_call(
+        functools.partial(_ivf_adc_kernel, m_count, ksub),
+        out_shape=jax.ShapeDtypeStruct((b, cand_pad), jnp.float32),
+        interpret=_pk.interpret(),
+    )(lut, flat_codes, seg, pos)
+    d2 = jnp.where(valid, d2, jnp.inf)
+    ids = jnp.where(valid, flat_ids[pos], -1)
+    neg, p2 = lax.top_k(-d2, k)
+    took = jnp.take_along_axis(ids, p2, axis=1)
+    return jnp.sqrt(jnp.maximum(-neg, 0.0)), took
+
+
+# --------------------------------------------------------------- int4 dot
+def _int4_dot_kernel(d, qq_ref, p_ref, out_ref):
+    packed = p_ref[...]
+    # unpack_nibbles inlined: two shifts sign-extend each nibble; the
+    # unpacked tile feeds the dot directly and never leaves VMEM
+    lo = jnp.right_shift(jnp.left_shift(packed, 4), 4)
+    hi = jnp.right_shift(packed, 4)
+    vecs = jnp.stack([lo, hi], axis=-1).reshape(
+        packed.shape[:-1] + (2 * packed.shape[-1],))[..., :d]
+    out_ref[...] = lax.dot_general(qq_ref[...], vecs,
+                                   (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.int32)
+
+
+def int4_matmul(qq, packed, d: int):
+    """int8 queries (b, d) × packed int4 table (n, ceil(d/2)) →
+    int32 (b, n): nibble unpack fused against the integer dot inside one
+    ``pallas_call``, gridded over table-row tiles. Bit-exact (integer
+    arithmetic end to end)."""
+    from jax.experimental import pallas as pl
+
+    b = qq.shape[0]
+    n, w = packed.shape
+    nblk = _nblk(n)
+    return pl.pallas_call(
+        functools.partial(_int4_dot_kernel, d),
+        grid=(n // nblk,),
+        in_specs=[
+            pl.BlockSpec((b, qq.shape[1]), lambda j: (0, 0)),
+            pl.BlockSpec((nblk, w), lambda j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((b, nblk), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((b, n), jnp.int32),
+        interpret=_pk.interpret(),
+    )(qq, packed)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def score_brute_int4(q, packed, vnorm2, scale_v, k: int, metric: str):
+    """Pallas int4 brute scorer with ``_score_brute_int4``'s signature:
+    per-row query quantization and the metric tail are the reference ops
+    in the reference order (bitwise-identical distances); only the
+    unpack+dot runs in-kernel."""
+    from deeplearning4j_tpu.retrieval.index import _score_quantize_rows
+
+    qq, scale_q = _score_quantize_rows(q)
+    doti = int4_matmul(qq, packed, q.shape[1])
+    dots = doti.astype(jnp.float32) * scale_q * scale_v[None, :]
+    if metric == "cosine":
+        cos = jnp.clip(dots, -1.0, 1.0)
+        neg, idx = lax.top_k(cos, k)
+        return jnp.arccos(neg), idx
+    d2 = vnorm2[None, :] - 2.0 * dots + jnp.sum(q * q, axis=1, keepdims=True)
+    neg, idx = lax.top_k(-d2, k)
+    return jnp.sqrt(jnp.maximum(-neg, 0.0)), idx

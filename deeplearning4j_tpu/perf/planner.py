@@ -76,6 +76,10 @@ class MemoryPlan:
     candidates_evaluated: int
     search_seconds: float
     augmentation: object = None
+    # kernel-layer snapshot at plan time (perf.pallas.selection_snapshot):
+    # family -> "pallas" | "xla" — a plan's measured/predicted bytes are
+    # only valid under the kernel selection it was planned with
+    kernels: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     def total_bytes(self) -> int:
         used = (self.measured_activation_bytes
@@ -108,12 +112,21 @@ class MemoryPlan:
         ]
         for key, pol in sorted(self.remat.items()):
             lines.append(f"    {key}: remat={pol}")
+        if self.kernels:
+            n_pallas = sum(1 for v in self.kernels.values() if v == "pallas")
+            lines.append(f"  kernels: {n_pallas}/{len(self.kernels)} "
+                         f"families on pallas")
         lines.append(f"  search: {self.candidates_evaluated} candidate(s) "
                      f"in {self.search_seconds:.2f}s")
         return "\n".join(lines)
 
 
 # ------------------------------------------------------------------ helpers
+def _pallas_snapshot() -> Dict[str, str]:
+    from deeplearning4j_tpu.perf import pallas as _pk
+    return _pk.selection_snapshot()
+
+
 def _layer_entries(conf) -> List[Tuple[str, object, int]]:
     """(key, layer, order index) for every layer a remat knob can land on.
     Keys follow the quant/ slot convention: ``layer<i>`` for stacks, the
@@ -294,7 +307,8 @@ def plan_memory(conf, budget_bytes: int, minibatch: int = 32,
                 remat={k: policy for _b, k, _i in chosen},
                 candidates_evaluated=candidates,
                 search_seconds=time.perf_counter() - t0,
-                augmentation=augmentation)
+                augmentation=augmentation,
+                kernels=_pallas_snapshot())
             aggressive_last = (ci == len(counts) - 1
                                and (fused_flag, base) == branches[-1])
             if predicted > act_budget and not aggressive_last:

@@ -53,11 +53,14 @@ from deeplearning4j_tpu.nn.conf.layers import (
     BaseOutputLayer, DenseLayer, Layer, OutputLayer, register_layer,
 )
 from deeplearning4j_tpu.quant.observers import QMAX
+from deeplearning4j_tpu.quant.pack import (packed_width, quantize_int4,
+                                           unpack_nibbles)
 
 __all__ = [
     "QuantizedDenseLayer", "QuantizedConvolutionLayer",
     "QuantizedConvolution1DLayer", "QuantizedOutputLayer",
-    "quantize", "quantizable_kind", "quantize_weights", "is_quantized",
+    "quantize", "quantizable_kind", "quantize_weights",
+    "quantize_weights_int4", "is_quantized",
     "quantized_layers", "input_quant_scale", "param_bytes",
 ]
 
@@ -90,6 +93,43 @@ def _requantize(acc_i32, act_scale: float, w_scale):
     return acc_i32 * (jnp.float32(act_scale) * w_scale)
 
 
+def quantize_weights_int4(w) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-output-channel symmetric int4 weight quantization: the
+    :func:`quantize_weights` recipe one rung down, through
+    ``quant.pack.quantize_int4``'s shared grid (codes in [-7, 7], two per
+    byte). Rows of the packed matrix are OUTPUT CHANNELS — ``Wq`` is
+    ``(n_out, ceil(fan_in/2))`` int8, unpacked in-kernel next to the
+    int32 matmul. Returns ``(Wq packed, scale f32[n_out])``."""
+    w = np.asarray(w)
+    w2d = np.ascontiguousarray(w.reshape(-1, w.shape[-1]).T)  # (n_out, fan)
+    packed, scales, _ = quantize_int4(w2d)
+    return packed, scales.astype(np.float32)
+
+
+def _dense_int4_acc(xq, wq_packed, n_in: int):
+    """int8 activations × packed int4 weights → int32, unpack fused
+    against the dot: the Pallas ``int4_dot`` kernel when selection
+    resolves to it (2-D activations), the jnp in-program unpack (which
+    XLA fuses into the dot operand) otherwise."""
+    from deeplearning4j_tpu.perf import pallas as _pk
+    if _pk.take("int4_dot", xq.ndim == 2):
+        from deeplearning4j_tpu.perf.pallas import adc as _pk_adc
+        return _pk_adc.int4_matmul(xq, wq_packed, n_in)
+    w8 = unpack_nibbles(wq_packed, n_in)                  # (n_out, n_in)
+    return lax.dot_general(xq, w8, (((xq.ndim - 1,), (1,)), ((), ())),
+                           preferred_element_type=jnp.int32)
+
+
+def _conv_weight_int8(wq_packed, spatial, c_in: int, n_out: int):
+    """Unpack packed int4 conv weights in-program back to the conv's
+    native layout (HWIO / WIO): rows are output channels, fan-in keeps
+    the (spatial..., c_in) order the lowering flattened."""
+    fan = int(np.prod(spatial)) * c_in
+    w8 = unpack_nibbles(wq_packed, fan)
+    w8 = w8.reshape((n_out,) + tuple(spatial) + (c_in,))
+    return jnp.moveaxis(w8, 0, -1)                        # (*spatial, ci, co)
+
+
 # ---------------------------------------------------------------- layers
 @register_layer
 @dataclasses.dataclass(frozen=True)
@@ -101,6 +141,7 @@ class QuantizedDenseLayer(Layer):
     has_bias: bool = True
     activation: str = "identity"
     act_scale: float = 1.0
+    weight_bits: int = 8
 
     def input_kind(self):
         return "ff"
@@ -113,7 +154,9 @@ class QuantizedDenseLayer(Layer):
 
     def init(self, rng, input_type, dtype=jnp.float32):
         n_in = self.n_in or input_type.flat_size()
-        params = {"Wq": jnp.zeros((n_in, self.n_out), jnp.int8),
+        wq_shape = ((self.n_out, packed_width(n_in))
+                    if self.weight_bits == 4 else (n_in, self.n_out))
+        params = {"Wq": jnp.zeros(wq_shape, jnp.int8),
                   "w_scale": jnp.ones((self.n_out,), jnp.float32)}
         if self.has_bias:
             params["b"] = jnp.zeros((self.n_out,), jnp.float32)
@@ -121,9 +164,12 @@ class QuantizedDenseLayer(Layer):
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         xq = quantize_activation(x, self.act_scale)
-        acc = lax.dot_general(xq, params["Wq"],
-                              (((x.ndim - 1,), (0,)), ((), ())),
-                              preferred_element_type=jnp.int32)
+        if self.weight_bits == 4:
+            acc = _dense_int4_acc(xq, params["Wq"], self.n_in)
+        else:
+            acc = lax.dot_general(xq, params["Wq"],
+                                  (((x.ndim - 1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
         z = _requantize(acc, self.act_scale, params["w_scale"])
         if self.has_bias:
             z = z + params["b"]
@@ -146,6 +192,7 @@ class QuantizedConvolutionLayer(Layer):
     has_bias: bool = True
     activation: str = "identity"
     act_scale: float = 1.0
+    weight_bits: int = 8
 
     def input_kind(self):
         return "cnn"
@@ -159,7 +206,10 @@ class QuantizedConvolutionLayer(Layer):
     def init(self, rng, it: InputType, dtype=jnp.float32):
         kh, kw = _pair(self.kernel_size)
         c_in = self.n_in or it.channels
-        params = {"Wq": jnp.zeros((kh, kw, c_in, self.n_out), jnp.int8),
+        wq_shape = ((self.n_out, packed_width(kh * kw * c_in))
+                    if self.weight_bits == 4
+                    else (kh, kw, c_in, self.n_out))
+        params = {"Wq": jnp.zeros(wq_shape, jnp.int8),
                   "w_scale": jnp.ones((self.n_out,), jnp.float32)}
         if self.has_bias:
             params["b"] = jnp.zeros((self.n_out,), jnp.float32)
@@ -173,8 +223,13 @@ class QuantizedConvolutionLayer(Layer):
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         xq = quantize_activation(x, self.act_scale)
+        if self.weight_bits == 4:
+            w = _conv_weight_int8(params["Wq"], _pair(self.kernel_size),
+                                  self.n_in, self.n_out)
+        else:
+            w = params["Wq"]
         acc = lax.conv_general_dilated(
-            xq, params["Wq"],
+            xq, w,
             window_strides=_pair(self.stride),
             padding=self._pad_cfg(),
             rhs_dilation=_pair(self.dilation),
@@ -201,6 +256,7 @@ class QuantizedConvolution1DLayer(Layer):
     has_bias: bool = True
     activation: str = "identity"
     act_scale: float = 1.0
+    weight_bits: int = 8
 
     def input_kind(self):
         return "rnn"
@@ -213,8 +269,10 @@ class QuantizedConvolution1DLayer(Layer):
 
     def init(self, rng, it: InputType, dtype=jnp.float32):
         c_in = self.n_in or it.size
-        params = {"Wq": jnp.zeros((self.kernel_size, c_in, self.n_out),
-                                  jnp.int8),
+        wq_shape = ((self.n_out, packed_width(self.kernel_size * c_in))
+                    if self.weight_bits == 4
+                    else (self.kernel_size, c_in, self.n_out))
+        params = {"Wq": jnp.zeros(wq_shape, jnp.int8),
                   "w_scale": jnp.ones((self.n_out,), jnp.float32)}
         if self.has_bias:
             params["b"] = jnp.zeros((self.n_out,), jnp.float32)
@@ -222,10 +280,15 @@ class QuantizedConvolution1DLayer(Layer):
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         xq = quantize_activation(x, self.act_scale)
+        if self.weight_bits == 4:
+            w = _conv_weight_int8(params["Wq"], (self.kernel_size,),
+                                  self.n_in, self.n_out)
+        else:
+            w = params["Wq"]
         pad = ("SAME" if self.convolution_mode == "same"
                else ((self.padding, self.padding),))
         acc = lax.conv_general_dilated(
-            xq, params["Wq"], window_strides=(self.stride,), padding=pad,
+            xq, w, window_strides=(self.stride,), padding=pad,
             rhs_dilation=(self.dilation,),
             dimension_numbers=("NWC", "WIO", "NWC"),
             preferred_element_type=jnp.int32)
@@ -248,6 +311,7 @@ class QuantizedOutputLayer(BaseOutputLayer):
     has_bias: bool = True
     activation: str = "softmax"
     act_scale: float = 1.0
+    weight_bits: int = 8
 
     def input_kind(self):
         return "ff"
@@ -260,7 +324,9 @@ class QuantizedOutputLayer(BaseOutputLayer):
 
     def init(self, rng, input_type, dtype=jnp.float32):
         n_in = self.n_in or input_type.flat_size()
-        params = {"Wq": jnp.zeros((n_in, self.n_out), jnp.int8),
+        wq_shape = ((self.n_out, packed_width(n_in))
+                    if self.weight_bits == 4 else (n_in, self.n_out))
+        params = {"Wq": jnp.zeros(wq_shape, jnp.int8),
                   "w_scale": jnp.ones((self.n_out,), jnp.float32)}
         if self.has_bias:
             params["b"] = jnp.zeros((self.n_out,), jnp.float32)
@@ -268,9 +334,12 @@ class QuantizedOutputLayer(BaseOutputLayer):
 
     def pre_output(self, params, x):
         xq = quantize_activation(x, self.act_scale)
-        acc = lax.dot_general(xq, params["Wq"],
-                              (((x.ndim - 1,), (0,)), ((), ())),
-                              preferred_element_type=jnp.int32)
+        if self.weight_bits == 4:
+            acc = _dense_int4_acc(xq, params["Wq"], self.n_in)
+        else:
+            acc = lax.dot_general(xq, params["Wq"],
+                                  (((x.ndim - 1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
         z = _requantize(acc, self.act_scale, params["w_scale"])
         if self.has_bias:
             z = z + params["b"]
@@ -302,16 +371,24 @@ def quantizable_kind(layer) -> Optional[str]:
     return None
 
 
-def _lower_layer(layer, kind: str, params: dict, act_scale: float):
-    """One layer's int8 lowering: quantized config + quantized params."""
+def _lower_layer(layer, kind: str, params: dict, act_scale: float,
+                 weight_bits: int = 8):
+    """One layer's integer lowering: quantized config + quantized params
+    (per-channel int8 weights, or packed per-channel int4 when
+    ``weight_bits == 4``)."""
     w = np.asarray(params["W"])
-    wq, ws = quantize_weights(w)
+    if weight_bits == 4:
+        wq, ws = quantize_weights_int4(w)
+    else:
+        wq, ws = quantize_weights(w)
     has_bias = "b" in params
     s = float(act_scale)
+    wb = int(weight_bits)
     if kind == "dense":
         ql = QuantizedDenseLayer(
             name=layer.name, n_in=w.shape[0], n_out=w.shape[1],
-            has_bias=has_bias, activation=layer.activation, act_scale=s)
+            has_bias=has_bias, activation=layer.activation, act_scale=s,
+            weight_bits=wb)
     elif kind == "conv":
         ql = QuantizedConvolutionLayer(
             name=layer.name, n_in=w.shape[2], n_out=w.shape[3],
@@ -319,7 +396,7 @@ def _lower_layer(layer, kind: str, params: dict, act_scale: float):
             padding=layer.padding,
             convolution_mode=layer.convolution_mode,
             dilation=layer.dilation, has_bias=has_bias,
-            activation=layer.activation, act_scale=s)
+            activation=layer.activation, act_scale=s, weight_bits=wb)
     elif kind == "conv1d":
         ql = QuantizedConvolution1DLayer(
             name=layer.name, n_in=w.shape[1], n_out=w.shape[2],
@@ -327,12 +404,13 @@ def _lower_layer(layer, kind: str, params: dict, act_scale: float):
             padding=layer.padding,
             convolution_mode=layer.convolution_mode,
             dilation=layer.dilation, has_bias=has_bias,
-            activation=layer.activation, act_scale=s)
+            activation=layer.activation, act_scale=s, weight_bits=wb)
     elif kind == "output":
         ql = QuantizedOutputLayer(
             name=layer.name, n_in=w.shape[0], n_out=w.shape[1],
             has_bias=has_bias, activation=layer.activation,
-            loss=layer.loss, loss_weights=layer.loss_weights, act_scale=s)
+            loss=layer.loss, loss_weights=layer.loss_weights, act_scale=s,
+            weight_bits=wb)
     else:
         raise KeyError(kind)
     qp = {"Wq": jnp.asarray(wq), "w_scale": jnp.asarray(ws)}
@@ -346,7 +424,7 @@ def _copy_tree(tree):
     return jax.tree_util.tree_map(jnp.array, tree)
 
 
-def _quantize_multilayer(net, record):
+def _quantize_multilayer(net, record, weight_bits: int = 8):
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
     new_layers, new_params, new_state = [], [], []
@@ -358,7 +436,8 @@ def _quantize_multilayer(net, record):
             new_params.append(_copy_tree(net.params[i]))
             new_state.append(_copy_tree(net.state[i]))
             continue
-        ql, qp = _lower_layer(l, kind, net.params[i], record.scale(key))
+        ql, qp = _lower_layer(l, kind, net.params[i], record.scale(key),
+                              weight_bits)
         new_layers.append(ql)
         new_params.append(qp)
         new_state.append({})
@@ -374,7 +453,7 @@ def _quantize_multilayer(net, record):
     return out
 
 
-def _quantize_graph(net, record):
+def _quantize_graph(net, record, weight_bits: int = 8):
     from deeplearning4j_tpu.nn.graph import ComputationGraph
 
     vertices = dict(net.conf.vertices)
@@ -388,7 +467,7 @@ def _quantize_graph(net, record):
         if kind is None or name not in record.ranges:
             continue
         ql, qp = _lower_layer(obj, kind, net.params[name],
-                              record.scale(name))
+                              record.scale(name), weight_bits)
         vertices[name] = (ql, ins)
         params[name] = qp
         state[name] = {}
@@ -403,9 +482,16 @@ def _quantize_graph(net, record):
     return out
 
 
-def quantize(net, calibration, fold: bool = True):
-    """Lower a network to its int8 serving graph using a calibration record
-    (quant/calibrate.py).
+def quantize(net, calibration, fold: bool = True, weight_bits: int = 8):
+    """Lower a network to its integer serving graph using a calibration
+    record (quant/calibrate.py).
+
+    ``weight_bits=4`` swaps the weight grid for packed per-output-channel
+    int4 (quant/pack.py — two codes per byte resident, unpacked in-kernel
+    next to the int32 matmul; activations stay int8): ~8x smaller weights
+    than f32. Judge the result with the SAME
+    ``quant.gates.assert_accuracy_within`` gate as int8 — int4 gives up
+    more accuracy, so gate before serving.
 
     Folds BN first (``fold=True``, the default — quantization targets the
     serving graph; pass ``fold=False`` for a net calibrated with
@@ -428,6 +514,8 @@ def quantize(net, calibration, fold: bool = True):
             "quantize() needs a CalibrationRecord (run quant.calibrate "
             f"over a representative batch stream); got "
             f"{type(calibration).__name__}")
+    if int(weight_bits) not in (4, 8):
+        raise ValueError(f"weight_bits must be 4 or 8; got {weight_bits}")
     if net.params is None:
         net.init()
     if fold:
@@ -443,9 +531,9 @@ def quantize(net, calibration, fold: bool = True):
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
     if isinstance(net, MultiLayerNetwork):
-        out = _quantize_multilayer(net, calibration)
+        out = _quantize_multilayer(net, calibration, int(weight_bits))
     else:
-        out = _quantize_graph(net, calibration)
+        out = _quantize_graph(net, calibration, int(weight_bits))
     out._quant_calibration = calibration
     from deeplearning4j_tpu.obs.registry import get_registry
     reg = get_registry()

@@ -694,8 +694,12 @@ class BruteForceIndex(_DeviceIndex):
 
     def _wire(self):
         if self.int4:
-            self._score = self.compile_watch.wrap(_score_brute_int4,
-                                                  "retrieval.brute_int4")
+            from deeplearning4j_tpu.perf import pallas as _pk
+            from deeplearning4j_tpu.perf.pallas import adc as _pk_adc
+            self._score = self.compile_watch.wrap(
+                _pk.kernel_select("int4_dot", _pk_adc.score_brute_int4,
+                                  _score_brute_int4),
+                "retrieval.brute_int4")
         elif self.int8:
             self._score = self.compile_watch.wrap(_score_brute_int8,
                                                   "retrieval.brute_int8")

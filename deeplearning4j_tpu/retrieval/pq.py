@@ -270,7 +270,11 @@ class PQIndex(_DeviceIndex):
         self.pq = codec
         self._codes = jnp.asarray(codes)
         self._codebooks = jnp.asarray(codec.codebooks)
-        self._score = self.compile_watch.wrap(_score_pq, "retrieval.pq")
+        from deeplearning4j_tpu.perf import pallas as _pk
+        from deeplearning4j_tpu.perf.pallas import adc as _pk_adc
+        self._score = self.compile_watch.wrap(
+            _pk.kernel_select("adc_pq", _pk_adc.score_pq, _score_pq),
+            "retrieval.pq")
 
     def _candidates(self) -> int:
         return self.size
@@ -384,8 +388,12 @@ class IVFPQIndex(_DeviceIndex):
         self._flat_ids = jnp.asarray(order.astype(np.int32))
         self._offsets = jnp.asarray(np.concatenate(
             [[0], np.cumsum(counts)]).astype(np.int32))
-        self._score = self.compile_watch.wrap(_score_ivf_pq,
-                                              "retrieval.ivf_pq")
+        from deeplearning4j_tpu.perf import pallas as _pk
+        from deeplearning4j_tpu.perf.pallas import adc as _pk_adc
+        self._score = self.compile_watch.wrap(
+            _pk.kernel_select("adc_ivf_pq", _pk_adc.score_ivf_pq,
+                              _score_ivf_pq),
+            "retrieval.ivf_pq")
 
     def _candidates(self) -> int:
         return min(self.size, self.cand_pad)

@@ -840,6 +840,85 @@ class TestHostNibbleUnpack:
         """, path=self.PACK_PATH) == []
 
 
+class TestHostWorkInPallasKernel:
+    KERNEL_PATH = "deeplearning4j_tpu/perf/pallas/fixture.py"
+
+    def test_fires_on_host_calls_in_kernel_body(self):
+        vs = _lint("""
+            import numpy as np
+            import jax
+            def _bad_kernel(x_ref, o_ref):
+                v = np.sum(x_ref[...])
+                s = x_ref[0, 0].item()
+                h = jax.device_get(x_ref[...])
+                o_ref[...] = v
+        """, path=self.KERNEL_PATH)
+        assert _rules(vs) == ["DLT015"] * 3
+        assert "host numpy" in vs[0].message
+        assert ".item()" in vs[1].message
+        assert "device_get" in vs[2].message
+
+    def test_fires_on_unhoisted_control_flow(self):
+        vs = _lint("""
+            def _bad_kernel(x_ref, o_ref):
+                s = 4
+                while s > 0:
+                    s -= 1
+                for row in x_ref[...]:
+                    pass
+                if x_ref:
+                    o_ref[...] = x_ref[...]
+        """, path=self.KERNEL_PATH)
+        assert _rules(vs) == ["DLT015"] * 3
+        assert "'while'" in vs[0].message
+        assert "non-range" in vs[1].message
+        assert "kernel block ref" in vs[2].message
+
+    def test_detects_refs_vararg_kernels(self):
+        # Kernels taking ``*refs`` (partial-bound statics) are still in scope.
+        vs = _lint("""
+            import numpy as np
+            def accumulate(n_rows, *refs):
+                z_ref, o_ref = refs
+                o_ref[...] = np.asarray(z_ref[...])
+        """, path=self.KERNEL_PATH)
+        assert _rules(vs) == ["DLT015"]
+
+    def test_clean_kernel_passes(self):
+        # Static-bool ``if`` and ``for m in range(...)`` are the sanctioned
+        # unroll idioms — must not be flagged.
+        assert _lint("""
+            def _clean_kernel(m_count, has_res, x_ref, o_ref):
+                acc = x_ref[...] * 0
+                for m in range(m_count):
+                    acc = acc + x_ref[...]
+                if has_res:
+                    acc = acc + 1
+                o_ref[...] = acc
+        """, path=self.KERNEL_PATH) == []
+
+    def test_non_kernel_function_ignored(self):
+        assert _lint("""
+            import numpy as np
+            def build_lut(codebooks):
+                return np.einsum("mkd,mkd->mk", codebooks, codebooks)
+        """, path=self.KERNEL_PATH) == []
+
+    def test_out_of_scope_path_clean(self):
+        assert _lint("""
+            import numpy as np
+            def _bad_kernel(x_ref, o_ref):
+                o_ref[...] = np.sum(x_ref[...])
+        """, path="deeplearning4j_tpu/retrieval/fixture.py") == []
+
+    def test_inline_waiver(self):
+        assert _lint("""
+            import numpy as np
+            def _probe_kernel(x_ref, o_ref):
+                o_ref[...] = np.sum(x_ref[...])  # lint: disable=DLT015 (interpret-only debug probe)
+        """, path=self.KERNEL_PATH) == []
+
+
 class TestFileWaiver:
     def test_disable_file(self):
         vs = _lint("""
