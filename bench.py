@@ -77,6 +77,12 @@ NOMINAL = {
     "autotune": 1.0,            # x, tuned-vs-default step-time ratio
                                 # (>= 1 means the record's choice is at
                                 # least as fast as the default execution)
+    "fleet": 5.0,               # ms, nominal router-hop overhead budget
+                                # (one lease-table lookup + one proxied
+                                # loopback HTTP round trip)
+    "fleet_scaleup": 10.0,      # s, nominal cold-replica time-to-ready
+                                # (restore + TuningRecord ladder warmup,
+                                # no serve-path compiles)
     "pallas": 1.0,              # x, identity denominator: bench_pallas
                                 # metrics come in kernel-on/off PAIRS and
                                 # the on-arm's speedup_vs_off field is the
@@ -649,6 +655,130 @@ def bench_serving_load():
               "(b64_int8 is the quantized-endpoint wire format). "
               "metrics only — thresholds on quiet full runs per the 9p "
               "note. " % (sizes, deadline_ms) + _REPS_NOTE)
+
+
+def bench_fleet():
+    """Fleet-tier overhead and elasticity: (a) predict p50/p99 direct to
+    one ModelServer vs through the FleetRouter over 2 replicas — the
+    router hop is one lease-table lookup + one proxied HTTP round trip,
+    so the delta is the routing tax; (b) scale-up time-to-ready: lease
+    write of a fresh (cold) replica → first 200 THROUGH the router for a
+    model only that replica hosts — the instant-start story end to end
+    (warmup off-path, lease flips only when ready, router never routes
+    cold). Metrics only per the 9p/bench-sensitivity note."""
+    import urllib.error
+    import urllib.request
+
+    from deeplearning4j_tpu.checkpoint.storage import ObjectStoreBackend
+    from deeplearning4j_tpu.fleet import FleetRouter, FleetView, ServingReplica
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    from deeplearning4j_tpu.serving import ModelServer
+
+    n_requests, hidden = (30, 32) if QUICK else (200, 256)
+    n_features, n_classes = 784, 10
+
+    def _net(seed):
+        conf = (NeuralNetConfiguration.builder().seed(seed)
+                .updater(Sgd(learning_rate=0.01)).weight_init("xavier")
+                .list()
+                .layer(DenseLayer(n_out=hidden, activation="relu"))
+                .layer(OutputLayer(n_out=n_classes, loss="mcxent"))
+                .set_input_type(InputType.feed_forward(n_features))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    example = np.zeros((1, n_features), np.float32)
+    rng = np.random.default_rng(77)
+    body = json.dumps({"inputs": rng.standard_normal(
+        (4, n_features)).astype(np.float32).tolist()}).encode()
+
+    def _drive(url, n):
+        lat = []
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"})
+        for _ in range(n):
+            sw = Stopwatch().start()
+            with urllib.request.urlopen(req, timeout=30) as r:
+                r.read()
+                assert r.status == 200
+            lat.append(sw.stop() * 1000.0)
+        return lat
+
+    # (a) direct single server
+    direct = ModelServer(port=0)
+    direct.add_model("mlp", _net(0), warmup_example=example)
+    direct.start(warmup_async=False)
+    lat_direct = _drive(direct.address + "/v1/models/mlp:predict",
+                        n_requests)
+    direct.stop(drain=True)
+
+    # (a) same model behind the router over 2 warmed replicas
+    store = ObjectStoreBackend()
+    replicas = []
+    for i in range(2):
+        srv = ModelServer(port=0)
+        srv.add_model("mlp", _net(i), warmup_example=example)
+        replicas.append(ServingReplica(srv, store, f"bench{i}",
+                                       heartbeat_s=0.5).start())
+    for r in replicas:
+        r.wait_ready(300)
+    router = FleetRouter(FleetView(store), refresh_s=0.1, seed=0).start()
+    lat_routed = _drive(router.address + "/v1/models/mlp:predict",
+                        n_requests)
+
+    # (b) scale-up: cold replica hosting a model nothing else hosts;
+    # clock runs from BEFORE its first lease write to the first 200
+    # through the router
+    srv3 = ModelServer(port=0)
+    srv3.add_model("scaled", _net(7), warmup_example=example)
+    rep3 = ServingReplica(srv3, store, "bench-scaleup", heartbeat_s=0.5)
+    url3 = router.address + "/v1/models/scaled:predict"
+    req3 = urllib.request.Request(
+        url3, data=body, headers={"Content-Type": "application/json"})
+    sw_up = Stopwatch().start()
+    rep3.start()
+    first_200_s = None
+    deadline = time.perf_counter() + 300.0
+    while time.perf_counter() < deadline:
+        try:
+            with urllib.request.urlopen(req3, timeout=10) as r:
+                r.read()
+                if r.status == 200:
+                    first_200_s = float(sw_up.stop())
+                    break
+        except urllib.error.HTTPError as e:
+            e.read()  # 503 no_replica until the lease flips warmed
+        except Exception:
+            pass
+        time.sleep(0.05)
+
+    router.stop()
+    rep3.stop(drain_timeout_s=5.0)
+    for r in replicas:
+        r.stop(drain_timeout_s=5.0)
+
+    p50_d = float(np.percentile(lat_direct, 50))
+    p50_r = float(np.percentile(lat_routed, 50))
+    emit("fleet_router_overhead_p50_ms", p50_r - p50_d, "ms", "fleet",
+         direct_p50_ms=round(p50_d, 2),
+         direct_p99_ms=round(float(np.percentile(lat_direct, 99)), 2),
+         routed_p50_ms=round(p50_r, 2),
+         routed_p99_ms=round(float(np.percentile(lat_routed, 99)), 2),
+         requests=n_requests, replicas=2,
+         note="sequential predicts, direct ModelServer vs through the "
+              "FleetRouter (2 warmed replicas); the delta is the "
+              "router hop. metrics only per the 9p note. " + _REPS_NOTE)
+    emit("fleet_scale_up_time_to_ready_s",
+         first_200_s if first_200_s is not None else -1.0,
+         "s", "fleet_scaleup",
+         note="cold replica start (lease write) -> first 200 through "
+              "the router for a model only it hosts: warmup runs "
+              "off-path and the lease flips warmed only when /readyz "
+              "would pass, so this is the true scale-up latency the "
+              "autoscaler pays. metrics only per the 9p note.")
 
 
 def bench_checkpoint():
@@ -1614,6 +1744,7 @@ def main():
     benches = [("lenet", bench_lenet), ("word2vec", bench_word2vec),
                ("charlstm", bench_graveslstm), ("serving", bench_serving),
                ("serving_load", bench_serving_load),
+               ("fleet", bench_fleet),
                ("checkpoint", bench_checkpoint),
                ("resilience", bench_resilience),
                ("elastic", bench_elastic),

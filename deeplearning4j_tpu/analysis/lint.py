@@ -150,6 +150,16 @@ Rules (waivable per line with ``# lint: disable=DLT00X`` or per file with
   and ``for m in range(M)`` unrolls are exempt by construction. Waivable
   inline like DLT003.
 
+- **DLT016 blocking-io-without-timeout**: in ``fleet/`` + ``serving/``
+  paths, outbound socket/HTTP-client calls (``urllib.request.urlopen``,
+  ``http.client.HTTP(S)Connection``, ``socket.create_connection``,
+  ``requests.*``) must carry an explicit timeout. The stdlib default is
+  block-forever, and the router fans one client request out to replicas
+  — a single hung upstream without a timeout wedges a handler thread
+  permanently (under a burst, all of them). An explicit positional
+  timeout argument counts; waivable inline for a deliberately unbounded
+  wait.
+
 Adding a rule: write a ``_rule_xxx(tree, src, path) -> List[LintViolation]``
 function and register it in ``_RULES``; tests in ``tests/test_lint.py``
 seed a fixture violating the rule and assert it fires.
@@ -1118,6 +1128,59 @@ def _rule_host_work_in_pallas_kernel(tree, src, path) -> List[LintViolation]:
     return out
 
 
+# ------------------------------------------------------------------ DLT016
+def _is_fleet_serving_path(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return any(seg in p for seg in ("fleet/", "serving/"))
+
+
+# blocking client entry points → the 1-based positional slot that can
+# carry the timeout (None: only the ``timeout=`` keyword can)
+_BLOCKING_IO_CALLS = {
+    "urllib.request.urlopen": 3,
+    "http.client.HTTPConnection": 3,
+    "http.client.HTTPSConnection": 3,
+    "socket.create_connection": 2,
+    "requests.get": None,
+    "requests.post": None,
+    "requests.put": None,
+    "requests.delete": None,
+    "requests.request": None,
+}
+
+
+def _rule_blocking_io_without_timeout(tree, src, path
+                                      ) -> List[LintViolation]:
+    """Outbound socket/HTTP-client calls in fleet/ + serving/ paths must
+    carry an explicit timeout: the router fans one client request out to
+    replicas, so a single hung upstream without a timeout wedges a
+    handler thread forever — under a burst, ALL of them — and the
+    default for every one of these stdlib calls is to block forever."""
+    if not _is_fleet_serving_path(path):
+        return []
+    aliases = _import_aliases(tree)
+    out: List[LintViolation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        q = _resolve(_dotted(node.func), aliases)
+        if q not in _BLOCKING_IO_CALLS:
+            continue
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            continue
+        slot = _BLOCKING_IO_CALLS[q]
+        if slot is not None and len(node.args) >= slot:
+            continue
+        out.append(LintViolation(
+            path, node.lineno, "DLT016",
+            f"'{q}(...)' without an explicit timeout in a fleet/serving "
+            "path — these calls block forever by default, so one hung "
+            "replica wedges a router/server handler thread (and under a "
+            "burst, all of them); pass timeout= (or waive inline for a "
+            "deliberately unbounded wait)"))
+    return out
+
+
 # ----------------------------------------------------------------- harness
 _RULES = (
     _rule_module_level_jnp,
@@ -1135,6 +1198,7 @@ _RULES = (
     _rule_host_work_in_retrieval,
     _rule_host_nibble_unpack,
     _rule_host_work_in_pallas_kernel,
+    _rule_blocking_io_without_timeout,
 )
 
 

@@ -1,0 +1,46 @@
+"""Serving fleet: lease-backed replica set, health-aware router, and
+SLO-driven autoscaling with instant warm start.
+
+The reference DL4J scales serving out with a fleet of Play servers over
+a Spark cluster tier (SURVEY §2.11); this package composes the layers
+this repo already has into the same shape, without a new control plane:
+
+- **Membership = the elastic trainer's lease protocol**
+  (:mod:`deeplearning4j_tpu.parallel.leases`): each replica writes a
+  TTL lease into a shared storage backend carrying its address, health,
+  placement (models + retrieval indexes it hosts) and warmup state
+  (``membership.py``).
+- **Replica** = one :class:`~deeplearning4j_tpu.serving.ModelServer`
+  process wrapped with the lease announcer and an off-path warmup that
+  only flips the lease to ``warmed`` once ``/readyz`` would pass — the
+  router never routes to a cold replica (``replica.py``).
+- **Router** = a front HTTP tier doing health-aware weighted routing
+  over live leases with per-model AND per-index placement, forwarding
+  the serving taxonomy (429/503/504) untouched, bounded per-replica
+  connections, and backoff retry-on-transient against a DIFFERENT
+  healthy replica — never retrying work a replica may have admitted
+  unless the route is idempotent (``router.py``).
+- **Autoscaler** = scale decisions driven by the SLO metrics ``obs/``
+  already exports, scraped from each replica's ``/metrics``
+  (``autoscaler.py``).
+
+Instant start: a fresh replica restores its checkpoint, inherits the
+persisted ``TuningRecord`` bucket ladder + pallas selection riding the
+checkpoint, warms off-path, then flips its lease — cold start costs
+seconds, not a compile storm in the serving path.
+"""
+
+from deeplearning4j_tpu.fleet.membership import (REPLICA_PREFIX, FleetView,
+                                                 ReplicaAnnouncer,
+                                                 ReplicaInfo)
+from deeplearning4j_tpu.fleet.replica import ServingReplica
+from deeplearning4j_tpu.fleet.router import FleetRouter
+from deeplearning4j_tpu.fleet.autoscaler import (Autoscaler,
+                                                 AutoscalerPolicy,
+                                                 parse_prometheus)
+
+__all__ = [
+    "REPLICA_PREFIX", "ReplicaInfo", "ReplicaAnnouncer", "FleetView",
+    "ServingReplica", "FleetRouter",
+    "Autoscaler", "AutoscalerPolicy", "parse_prometheus",
+]

@@ -736,6 +736,7 @@ class MultiLayerNetwork:
         e.g. a list or a re-iterable iterator in a fixed order)."""
         if self.params is None:
             self.init()
+        caller_iterator = labels is None and not isinstance(data, DataSet)
         if labels is not None:
             data = [DataSet(np.asarray(data), np.asarray(labels))]
         elif isinstance(data, DataSet):
@@ -780,6 +781,20 @@ class MultiLayerNetwork:
                     checkpoint_manager.epoch_end(self)
             return self
         train_step = self._get_jitted("train")
+        record = getattr(self, "_tuning_record", None)
+        if (caller_iterator and record is not None
+                and getattr(record, "batch_size", 0)):
+            # the tuned batch size is not advisory: a caller-supplied
+            # iterator is re-sliced to the size the record was tuned at,
+            # ABOVE the resume skip (like bucketing) so replay after a
+            # restore sees the identical batch stream
+            tuned = int(record.batch_size)
+            bs = getattr(data, "batch_size", None)
+            declared = bs() if callable(bs) else None
+            if declared != tuned:
+                from deeplearning4j_tpu.perf.bucketing import (
+                    RebatchDataSetIterator)
+                data = RebatchDataSetIterator(data, tuned)
         if bucket_policy is not None:
             from deeplearning4j_tpu.perf.bucketing import (
                 BucketPadDataSetIterator, BucketPolicy)

@@ -71,14 +71,13 @@ import dataclasses
 import json
 import logging
 import socket
-import threading
 import time
-import uuid
 from typing import Callable, Dict, List, Optional
+
+from deeplearning4j_tpu.parallel.leases import LEASE_PREFIX, LeaseBoard
 
 log = logging.getLogger(__name__)
 
-LEASE_PREFIX = "lease-"
 GEN_PREFIX = "gen-"
 BUMP_PREFIX = "bump-"
 
@@ -159,118 +158,9 @@ def _bump_name(generation: int) -> str:
 
 
 # ================================================================== leases
-class LeaseBoard:
-    """Per-worker heartbeat leases in the store.
-
-    A lease is ``lease-<worker_id>`` holding ``{worker_id, incarnation,
-    seq, time, barrier}``; a background thread refreshes it every
-    ``heartbeat_s`` (default ttl/3). ``barrier`` is the generation this
-    worker is ready to join — the rendezvous settles when every LIVE lease
-    has either reached the barrier or expired. Store faults during a
-    heartbeat are counted and logged, not fatal: liveness tolerates
-    missed beats up to the TTL (chaos tests inject FlakyBackend faults
-    here on purpose)."""
-
-    def __init__(self, store, worker_id: str, ttl_s: float = 10.0,
-                 heartbeat_s: Optional[float] = None,
-                 clock: Callable[[], float] = time.time):
-        from deeplearning4j_tpu.checkpoint.storage import as_backend
-        self.store = as_backend(store)
-        self.worker_id = str(worker_id)
-        self.ttl_s = float(ttl_s)
-        self.heartbeat_s = (float(heartbeat_s) if heartbeat_s is not None
-                            else self.ttl_s / 3.0)
-        self.clock = clock
-        self.incarnation = uuid.uuid4().hex[:12]
-        self._lock = threading.Lock()
-        self._barrier_gen = 0
-        self._seq = 0
-        self._last_write = float("-inf")
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-        self.heartbeat_errors = 0
-
-    # ------------------------------------------------------------- writing
-    def write(self, barrier: Optional[int] = None):
-        """Write this worker's lease now (also what the heartbeat thread
-        calls). ``barrier`` updates the joined-generation marker."""
-        with self._lock:
-            if barrier is not None:
-                self._barrier_gen = int(barrier)
-            self._seq += 1
-            rec = {"worker_id": self.worker_id,
-                   "incarnation": self.incarnation,
-                   "seq": self._seq,
-                   "time": self.clock(),
-                   "barrier": self._barrier_gen}
-        self.store.put(LEASE_PREFIX + self.worker_id,
-                       json.dumps(rec).encode())
-        self._last_write = self.clock()
-
-    def refresh_if_due(self):
-        """Heartbeat inline when no beat landed for a heartbeat interval
-        — keeps a worker alive through long WAITS (the rendezvous poll
-        loop) even when the background thread isn't running."""
-        if self.clock() - self._last_write >= self.heartbeat_s:
-            self.write()
-
-    def start(self):
-        if self._thread is not None and self._thread.is_alive():
-            return
-        self._stop.clear()
-
-        def beat():
-            while not self._stop.wait(self.heartbeat_s):
-                try:
-                    self.write()
-                except Exception as e:
-                    # a missed beat is survivable until the TTL; chaos
-                    # tests inject faults here deliberately
-                    self.heartbeat_errors += 1
-                    log.warning("lease heartbeat for %s failed (%s: %s)",
-                                self.worker_id, type(e).__name__, e)
-        self._thread = threading.Thread(
-            target=beat, name=f"lease-{self.worker_id}", daemon=True)
-        self._thread.start()
-
-    def stop(self):
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=self.heartbeat_s * 2 + 1)
-            self._thread = None
-
-    # ------------------------------------------------------------- reading
-    def read_all(self) -> Dict[str, dict]:
-        """Every parseable lease in the store, by worker id."""
-        out = {}
-        for name in self.store.list(prefix=LEASE_PREFIX):
-            try:
-                rec = json.loads(self.store.get(name).decode())
-                out[str(rec["worker_id"])] = rec
-            except Exception as e:
-                # an unreadable lease counts as absent (= expired); log so
-                # persistent corruption is visible
-                log.warning("unreadable lease %s (%s: %s)", name,
-                            type(e).__name__, e)
-        return out
-
-    def is_fresh(self, rec: dict, now: Optional[float] = None) -> bool:
-        now = self.clock() if now is None else now
-        return (now - float(rec.get("time", 0))) <= self.ttl_s
-
-    def live(self, leases: Optional[Dict[str, dict]] = None) -> Dict[str, dict]:
-        leases = self.read_all() if leases is None else leases
-        now = self.clock()
-        return {w: r for w, r in leases.items() if self.is_fresh(r, now)}
-
-    def withdraw(self):
-        """Delete this worker's lease (clean exit — peers need not wait a
-        TTL to notice)."""
-        try:
-            self.store.delete(LEASE_PREFIX + self.worker_id)
-        except Exception as e:
-            log.warning("lease withdraw for %s failed (%s: %s)",
-                        self.worker_id, type(e).__name__, e)
+# LeaseBoard lives in parallel/leases.py now (re-exported above): the
+# serving fleet registers replicas through the same lease protocol, so
+# the primitive moved out of the trainer-specific module.
 
 
 # =============================================================== rendezvous

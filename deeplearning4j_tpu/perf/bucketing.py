@@ -310,3 +310,55 @@ class BucketPadDataSetIterator:
         if hasattr(self._base, "batch_size"):
             return self.policy.bucket(self._base.batch_size())
         return None
+
+
+class RebatchDataSetIterator:
+    """Re-slice an iterable of DataSets to an exact target batch size —
+    how a tuned batch size (``perf.autotune.TuningRecord.batch_size``)
+    stops being advisory for fit callers that already hold an iterator.
+
+    Incoming batches are coalesced/split so every emitted batch has
+    exactly ``batch_size`` rows except a possibly-ragged final one (which
+    ``BucketPadDataSetIterator`` above, or the tuned bucket ladder, then
+    pads). Example order is preserved, so the stream is deterministic and
+    resume-safe; re-iterable iff the base is."""
+
+    def __init__(self, base, batch_size: int):
+        if int(batch_size) <= 0:
+            raise ValueError(f"batch_size must be positive, "
+                             f"got {batch_size}")
+        self._base = base
+        self._batch_size = int(batch_size)
+
+    def __iter__(self):
+        target = self._batch_size
+        buf: List[DataSet] = []
+        have = 0
+        for ds in self._base:
+            n = ds.num_examples()
+            if not buf and n == target:
+                yield ds  # already the tuned size: pass through untouched
+                continue
+            buf.append(ds)
+            have += n
+            if have < target:
+                continue
+            merged = buf[0] if len(buf) == 1 else DataSet.merge(buf)
+            chunks = merged.split(target)
+            if chunks[-1].num_examples() < target:
+                buf, have = [chunks[-1]], chunks[-1].num_examples()
+                chunks = chunks[:-1]
+            else:
+                buf, have = [], 0
+            yield from chunks
+        if buf:
+            # ragged final batch: emitted, not dropped (every example
+            # trains; the bucket ladder absorbs the odd shape)
+            yield buf[0] if len(buf) == 1 else DataSet.merge(buf)
+
+    def reset(self):
+        if hasattr(self._base, "reset"):
+            self._base.reset()
+
+    def batch_size(self):
+        return self._batch_size

@@ -919,6 +919,74 @@ class TestHostWorkInPallasKernel:
         """, path=self.KERNEL_PATH) == []
 
 
+class TestBlockingIoWithoutTimeout:
+    PATH = "deeplearning4j_tpu/fleet/router.py"
+
+    def test_fires_on_urlopen_without_timeout(self):
+        vs = _lint("""
+            import urllib.request
+            def scrape(addr):
+                return urllib.request.urlopen(addr + "/metrics").read()
+        """, path=self.PATH)
+        assert _rules(vs) == ["DLT016"]
+        assert "timeout" in vs[0].message
+
+    def test_fires_on_http_connection_without_timeout(self):
+        vs = _lint("""
+            import http.client
+            def forward(host, port):
+                return http.client.HTTPConnection(host, port)
+        """, path="deeplearning4j_tpu/serving/server.py")
+        assert _rules(vs) == ["DLT016"]
+
+    def test_fires_on_from_import_alias(self):
+        vs = _lint("""
+            from urllib.request import urlopen
+            def scrape(addr):
+                return urlopen(addr).read()
+        """, path=self.PATH)
+        assert _rules(vs) == ["DLT016"]
+
+    def test_fires_on_create_connection(self):
+        vs = _lint("""
+            import socket
+            def probe(addr):
+                return socket.create_connection(addr)
+        """, path=self.PATH)
+        assert _rules(vs) == ["DLT016"]
+
+    def test_clean_with_timeout_kwarg(self):
+        assert _lint("""
+            import http.client
+            import urllib.request
+            def forward(host, port, addr):
+                c = http.client.HTTPConnection(host, port, timeout=5.0)
+                return c, urllib.request.urlopen(addr, timeout=2.0)
+        """, path=self.PATH) == []
+
+    def test_clean_with_positional_timeout(self):
+        assert _lint("""
+            import socket
+            def probe(addr):
+                return socket.create_connection(addr, 5.0)
+        """, path=self.PATH) == []
+
+    def test_out_of_scope_path_is_exempt(self):
+        assert _lint("""
+            import urllib.request
+            def fetch(url):
+                return urllib.request.urlopen(url).read()
+        """, path="deeplearning4j_tpu/datasets/fetchers.py") == []
+
+    def test_inline_waiver(self):
+        assert _lint("""
+            import urllib.request
+            def fetch(url):
+                # deliberate unbounded wait: caller owns the deadline
+                return urllib.request.urlopen(url)  # lint: disable=DLT016
+        """, path=self.PATH) == []
+
+
 class TestFileWaiver:
     def test_disable_file(self):
         vs = _lint("""

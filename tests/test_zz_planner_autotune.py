@@ -277,6 +277,53 @@ def test_tuning_applied_to_fresh_fit_and_model_zip(tmp_path):
     assert back._tuning_record == rec
 
 
+def test_rebatch_iterator_reslices_preserving_order():
+    from deeplearning4j_tpu.perf.bucketing import RebatchDataSetIterator
+    dss = [DataSet(np.full((5, 2), i, np.float32),
+                   np.ones((5, 3), np.float32)) for i in range(3)]
+    it = RebatchDataSetIterator(dss, 8)
+    assert it.batch_size() == 8
+    sizes = [d.num_examples() for d in it]
+    assert sizes == [8, 7]  # 15 rows → one full batch + ragged tail
+    got = np.concatenate([d.features for d in it])
+    want = np.concatenate([d.features for d in dss])
+    assert np.array_equal(got, want)  # example order preserved
+    # re-iterable (the fit loop iterates once per epoch)
+    assert [d.num_examples() for d in it] == [8, 7]
+    # an already-tuned-size batch passes through as the same object
+    ds8 = DataSet(np.zeros((8, 2), np.float32), np.ones((8, 3), np.float32))
+    (only,) = list(RebatchDataSetIterator([ds8], 8))
+    assert only is ds8
+
+
+def test_tuned_batch_size_rebatches_fit_iterator():
+    """ISSUE-17 satellite (PR-13 leftover): the tuned batch size is no
+    longer advisory — fit() re-slices a caller-supplied iterator to
+    ``TuningRecord.batch_size``; raw-array/single-DataSet fits are
+    untouched."""
+    conf = _fusable_cnn_conf()
+    rec = autotune(conf, batch_sizes=(8,), top_k=1, reps=1)
+    assert rec.batch_size == 8
+
+    def _ds(n):
+        x = RNG.standard_normal((n, 8, 8, 3)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[RNG.integers(0, 3, n)]
+        return DataSet(x, y)
+
+    # 4 × batch-5 iterator → rebatched to [8, 8, 4] → 3 optimizer steps
+    net = build_network(conf, rec).init()
+    net.fit([_ds(5) for _ in range(4)])
+    assert net.iteration == 3
+    # a single DataSet (no iterator) keeps full-batch semantics: 1 step
+    net2 = build_network(conf, rec).init()
+    net2.fit(_ds(20))
+    assert net2.iteration == 1
+    # an iterator already at the tuned size is left alone: 2 steps
+    net3 = build_network(conf, rec).init()
+    net3.fit([_ds(8), _ds(8)])
+    assert net3.iteration == 2
+
+
 def test_tuning_checkpoint_ride_along_and_serving_inheritance(tmp_path):
     """ISSUE-13 acceptance: a TuningRecord round-trips through checkpoint
     storage and a ParallelInference built from the restored model inherits
