@@ -26,6 +26,13 @@ Three cooperating passes, all runnable before (or without) any XLA compile:
   sync before reading the clock, and a lock-order checker that flags
   inconsistent lock-acquisition orderings as deadlock risk. Runs over the
   whole package as a tier-1 test (``tests/test_lint.py``).
+
+- ``callgraph``: the whole-repo symbol table + conservative call graph
+  (content-hash cached per module) that powers the interprocedural rule
+  families — DLT017 host-work-reachable-from-jit (with the full call
+  chain in the message), DLT018 cross-module lock-order/IO-under-lock
+  analysis, DLT019 thread-lifecycle — plus the stale-waiver audit
+  (``lint.audit_waivers`` / ``run_lint.py --audit-waivers``).
 """
 
 from deeplearning4j_tpu.analysis.validation import (  # noqa: F401
@@ -41,6 +48,12 @@ from deeplearning4j_tpu.analysis.trace_check import (  # noqa: F401
 )
 from deeplearning4j_tpu.analysis.lint import (  # noqa: F401
     LintViolation,
+    StaleWaiver,
+    audit_waivers,
     lint_file,
     lint_paths,
+)
+from deeplearning4j_tpu.analysis.callgraph import (  # noqa: F401
+    CallGraph,
+    build_graph,
 )

@@ -22,9 +22,12 @@ Rules (waivable per line with ``# lint: disable=DLT00X`` or per file with
   unsynced stopwatch measures dispatch latency, not execution.
 
 - **DLT004 lock-order**: extracts nested lock-acquisition orderings per
-  class and flags a pair of locks taken in opposite orders by different
-  methods as deadlock risk (the ``parallel/`` + ``checkpoint/`` subsystems
-  are lock-heavy and multi-threaded).
+  class — through ``with`` blocks AND explicit ``acquire()`` /
+  ``release()`` sequences (including the ``acquire(); try: ... finally:
+  release()`` idiom) — and flags a pair of locks taken in opposite orders
+  by different methods as deadlock risk (the ``parallel/`` +
+  ``checkpoint/`` subsystems are lock-heavy and multi-threaded). Same-
+  class only; the cross-class/cross-module surface is DLT018's.
 
 - **DLT005 serving-bn-fold**: a file that builds a model with
   ``BatchNormalization`` AND serves it through ``ParallelInference`` —
@@ -160,19 +163,67 @@ Rules (waivable per line with ``# lint: disable=DLT00X`` or per file with
   timeout argument counts; waivable inline for a deliberately unbounded
   wait.
 
+Interprocedural rule families (DLT017-019) run over the whole-repo call
+graph built by ``analysis/callgraph.py`` — they only fire from
+``lint_paths`` (and the ``tools/run_lint.py`` CLI), never from
+single-file ``lint_file``, because they need the cross-module symbol
+table:
+
+- **DLT017 host-work-reachable-from-jit**: computes the closure of
+  functions reachable from every traced entry point (jit-decorated, or
+  passed to ``jax.jit``/``lax.scan``/``vmap``/... anywhere in the repo)
+  and re-applies the DLT002/009/013/014/015 host-work checks there:
+  wall-clock and host-RNG calls always (they freeze into the compiled
+  program at trace time — the DLT002 hazard, now visible N modules away);
+  ``.item()`` / ``jax.device_get`` / ``block_until_ready`` always (a
+  host-device sync or trace-time error inside the traced region); bare
+  ``np.*`` calls only in functions that ALSO use jnp/lax device math (the
+  DLT009/013/014 mixed host/device shape — pure-host helpers whose
+  results become trace-time constants by design are exempt). Only
+  functions ≥1 call-hop from the entry are reported (the entry's own body
+  is DLT002's), and the message carries the full call chain. Waivable
+  inline at the hazard line like DLT003.
+
+- **DLT018 cross-module-lock-analysis**: builds the global
+  lock-acquisition graph — ``with`` blocks and explicit ``acquire()`` /
+  ``release()`` pairs, with held-lock sets propagated through resolved
+  call edges — and flags (a) lock pairs acquired in opposite orders
+  anywhere in the repo, across classes and modules (same-class pairs
+  visible to DLT004 from direct nesting are left to DLT004), and (b)
+  blocking I/O (``urlopen``, ``HTTPConnection``, ``queue.get/put``,
+  ``subprocess``, ``block_until_ready``) executed — directly or via a
+  callee — while a lock is held, in serving/fleet/checkpoint/parallel
+  paths, where one slow upstream then convoys every thread behind the
+  lock. Waivable inline at the acquisition/call line like DLT003.
+
+- **DLT019 thread-lifecycle**: a ``threading.Thread`` started without
+  ``daemon=True`` and without a recorded ``join()``/stop path (a join on
+  the same local handle in the function, a join on the same ``self.``
+  attribute anywhere in the class, a post-hoc ``t.daemon = True`` /
+  ``setDaemon(True)``, or the handle being returned/pooled into a
+  collection that is joined) leaks on shutdown — the fleet CLI and
+  replica drain paths depend on clean teardown. Waivable inline at the
+  construction line like DLT003.
+
 Adding a rule: write a ``_rule_xxx(tree, src, path) -> List[LintViolation]``
 function and register it in ``_RULES``; tests in ``tests/test_lint.py``
-seed a fixture violating the rule and assert it fires.
+seed a fixture violating the rule and assert it fires. Interprocedural
+rules take the built ``CallGraph`` instead and register in
+``_REPO_RULES``.
 """
 
 from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import os
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-__all__ = ["LintViolation", "lint_file", "lint_paths", "DEFAULT_TARGETS"]
+from . import callgraph as _cg
+
+__all__ = ["LintViolation", "StaleWaiver", "lint_file", "lint_paths",
+           "audit_waivers", "clear_caches", "DEFAULT_TARGETS"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -429,11 +480,33 @@ def _rule_lock_order(tree, src, path) -> List[LintViolation]:
         # (outer, inner) -> [(method, line)]
         edges: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
 
-        def collect(nodes, held: List[str], method: str):
-            for node in nodes:
+        # Statements are walked IN ORDER with a mutable held-set so an
+        # explicit `self.x_lock.acquire()` persists across the following
+        # sibling statements (incl. a try: body whose finally: releases)
+        # and `release()` drops it again — the `with`-only walk missed
+        # every acquire/release-sequenced ordering.
+        def scan_explicit(node, held: List[str], method: str):
+            for sub in ast.walk(node):
+                if not (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in ("acquire", "release")):
+                    continue
+                ln = lock_name(sub.func.value)
+                if ln is None:
+                    continue
+                if sub.func.attr == "acquire":
+                    for h in held:
+                        edges.setdefault((h, ln), []).append(
+                            (method, sub.lineno))
+                    held.append(ln)
+                elif ln in held:
+                    held.remove(ln)
+
+        def collect(stmts, held: List[str], method: str):
+            for node in stmts:
                 if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     continue  # nested defs run later, with unknown holds
-                if isinstance(node, ast.With):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
                     acquired = []
                     for item in node.items:
                         ln = lock_name(item.context_expr)
@@ -442,9 +515,34 @@ def _rule_lock_order(tree, src, path) -> List[LintViolation]:
                                 edges.setdefault((h, ln), []).append(
                                     (method, node.lineno))
                             acquired.append(ln)
-                    collect(node.body, held + acquired, method)
+                    held.extend(acquired)
+                    collect(node.body, held, method)
+                    if acquired:
+                        del held[-len(acquired):]
                     continue
-                collect(ast.iter_child_nodes(node), held, method)
+                if isinstance(node, ast.Try):
+                    collect(node.body, held, method)
+                    for h in node.handlers:
+                        collect(h.body, held, method)
+                    collect(node.orelse, held, method)
+                    collect(node.finalbody, held, method)
+                    continue
+                if isinstance(node, ast.If):
+                    scan_explicit(node.test, held, method)
+                    collect(node.body, held, method)
+                    collect(node.orelse, held, method)
+                    continue
+                if isinstance(node, (ast.For, ast.AsyncFor)):
+                    scan_explicit(node.iter, held, method)
+                    collect(node.body, held, method)
+                    collect(node.orelse, held, method)
+                    continue
+                if isinstance(node, ast.While):
+                    scan_explicit(node.test, held, method)
+                    collect(node.body, held, method)
+                    collect(node.orelse, held, method)
+                    continue
+                scan_explicit(node, held, method)
 
         for meth in cls.body:
             if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -1181,6 +1279,247 @@ def _rule_blocking_io_without_timeout(tree, src, path
     return out
 
 
+# ------------------------------------------------- DLT017 (interprocedural)
+# consequence phrasing per hazard kind, for the message
+_DLT017_REASON = {
+    "clock": ("wall clock", "runs once at trace time and freezes into the "
+              "compiled program"),
+    "rng": ("host RNG", "runs once at trace time and freezes into the "
+            "compiled program"),
+    "np": ("host numpy", "mixed host/device code in the traced closure — "
+           "host math here materializes trace-time constants or forces a "
+           "per-step host sync"),
+    "item": ("device readback", "forces a host-device sync (and errors "
+             "outright on a traced value)"),
+    "device_get": ("device readback", "forces a host-device sync (and "
+                   "errors outright on a traced value)"),
+    "sync": ("host sync", "blocks on device completion inside the traced "
+             "closure"),
+}
+
+
+def _repo_rule_host_work_from_jit(graph: "_cg.CallGraph"
+                                  ) -> List[LintViolation]:
+    """DLT017: re-apply the host-work checks over everything reachable
+    from a traced entry, ≥1 call-hop away (the entry's own body is
+    DLT002's). Each hazard reports once, with the shortest entry chain."""
+    best: Dict[Tuple[str, int, str], Tuple[Tuple[str, ...], str]] = {}
+    for entry in graph.entries():
+        for qname, chain in graph.reachable_from(entry).items():
+            if len(chain) < 2 or qname in graph.traced_entries:
+                continue
+            fn = graph.functions.get(qname)
+            if fn is None:
+                continue
+            for hz in fn.hazards:
+                if hz.kind == "np" and not fn.uses_device:
+                    continue  # pure-host helper: trace-time constant by design
+                key = (fn.path, hz.lineno, hz.detail)
+                if key not in best or len(chain) < len(best[key][0]):
+                    best[key] = (chain, hz.kind)
+    out: List[LintViolation] = []
+    for (path, lineno, detail), (chain, kind) in sorted(best.items()):
+        label, consequence = _DLT017_REASON[kind]
+        hops = len(chain) - 1
+        out.append(LintViolation(
+            path, lineno, "DLT017",
+            f"'{detail}' ({label}) is reachable from traced entry "
+            f"'{chain[0]}' via {' -> '.join(chain)} ({hops} call hop"
+            f"{'s' if hops != 1 else ''} from the jit boundary) — "
+            f"{consequence}; thread the value in as an argument or hoist "
+            "the host work out of the traced path (or waive inline for a "
+            "deliberately trace-time computation)"))
+    return out
+
+
+# ------------------------------------------------- DLT018 (interprocedural)
+def _is_lock_io_path(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return any(seg in p for seg in ("fleet/", "serving/", "checkpoint/",
+                                    "parallel/"))
+
+
+def _repo_rule_cross_module_locks(graph: "_cg.CallGraph"
+                                  ) -> List[LintViolation]:
+    """DLT018: (a) opposite-order lock pairs anywhere in the repo, with
+    held-sets propagated through resolved call edges (same-class pairs
+    that DLT004 already sees from direct nesting are left to DLT004);
+    (b) blocking I/O — direct or via a callee — while a lock is held, in
+    serving/fleet/checkpoint/parallel paths."""
+    out: List[LintViolation] = []
+
+    # witness: (fn qname, file, line, via-callee-or-None)
+    wit: Dict[Tuple[str, str], List[Tuple[str, str, int, Optional[str]]]] = {}
+    for qname, acqs in graph.lock_acqs.items():
+        fn = graph.functions[qname]
+        for a in acqs:
+            for h in a.held:
+                if h != a.lock:
+                    wit.setdefault((h, a.lock), []).append(
+                        (qname, fn.path, a.lineno, None))
+    for qname, edges in graph.edges.items():
+        fn = graph.functions[qname]
+        for e in edges:
+            if not e.held:
+                continue
+            for lk in sorted(graph.acq_closure(e.callee)):
+                for h in e.held:
+                    if lk != h:
+                        wit.setdefault((h, lk), []).append(
+                            (qname, fn.path, e.lineno, e.callee))
+
+    adj: Dict[str, Set[str]] = {}
+    for (a, b) in wit:
+        adj.setdefault(a, set()).add(b)
+
+    def bfs_path(src: str, dst: str) -> Optional[List[str]]:
+        prev: Dict[str, str] = {src: src}
+        frontier = [src]
+        while frontier:
+            nxt = []
+            for n in frontier:
+                for m in sorted(adj.get(n, ())):
+                    if m in prev:
+                        continue
+                    prev[m] = n
+                    if m == dst:
+                        path = [m]
+                        while path[-1] != src:
+                            path.append(prev[path[-1]])
+                        return list(reversed(path))
+                    nxt.append(m)
+            frontier = nxt
+        return None
+
+    def describe(w) -> str:
+        qname, fpath, line, via = w
+        base = f"'{qname}' ({os.path.basename(fpath)}:{line})"
+        return f"{base} via call to '{via}'" if via else base
+
+    reported: Set[frozenset] = set()
+    for (a, b) in sorted(wit):
+        if (b, a) in wit:  # 2-cycle
+            pair = frozenset((a, b))
+            if pair in reported:
+                continue
+            reported.add(pair)
+            owner_a, owner_b = a.rsplit(".", 1)[0], b.rsplit(".", 1)[0]
+            direct_ab = any(w[3] is None for w in wit[(a, b)])
+            direct_ba = any(w[3] is None for w in wit[(b, a)])
+            if owner_a == owner_b and direct_ab and direct_ba:
+                continue  # same class, both orders directly nested: DLT004's
+            w1, w2 = wit[(a, b)][0], wit[(b, a)][0]
+            out.append(LintViolation(
+                w1[1], w1[2], "DLT018",
+                f"locks '{a}' and '{b}' are acquired in opposite orders: "
+                f"{describe(w1)} takes '{a}' then '{b}', but {describe(w2)} "
+                f"takes '{b}' then '{a}' — cross-module deadlock risk under "
+                "concurrent callers; pick one global order (or waive inline "
+                "if the two orders are provably never concurrent)"))
+        else:
+            cyc = bfs_path(b, a)
+            if not cyc:
+                continue
+            nodes = frozenset(cyc) | {a}
+            if nodes in reported:
+                continue
+            reported.add(nodes)
+            w1 = wit[(a, b)][0]
+            ring = " -> ".join([a, b] + cyc[1:])
+            out.append(LintViolation(
+                w1[1], w1[2], "DLT018",
+                f"lock-acquisition cycle {ring}: {describe(w1)} takes "
+                f"'{a}' then '{b}' and the remaining edges close the loop "
+                "— cross-module deadlock risk under concurrent callers; "
+                "break one edge of the cycle (or waive inline if the "
+                "orders are provably never concurrent)"))
+
+    seen_io: Set[Tuple[str, int, str]] = set()
+    for qname, ios in graph.io_held.items():
+        fn = graph.functions[qname]
+        if not _is_lock_io_path(fn.path):
+            continue
+        for what, lineno, held in ios:
+            if not held or (fn.path, lineno, what) in seen_io:
+                continue
+            seen_io.add((fn.path, lineno, what))
+            out.append(LintViolation(
+                fn.path, lineno, "DLT018",
+                f"blocking '{what}' while holding lock '{held[-1]}' in "
+                f"'{qname}' — every thread that needs the lock convoys "
+                "behind this wait; move the blocking call outside the "
+                "critical section (or waive inline for a deliberately "
+                "serialized wait)"))
+    for qname, edges in graph.edges.items():
+        fn = graph.functions[qname]
+        if not _is_lock_io_path(fn.path):
+            continue
+        for e in edges:
+            if not e.held:
+                continue
+            for what in sorted(graph.io_closure(e.callee)):
+                if (fn.path, e.lineno, what) in seen_io:
+                    continue
+                seen_io.add((fn.path, e.lineno, what))
+                out.append(LintViolation(
+                    fn.path, e.lineno, "DLT018",
+                    f"call to '{e.callee}' performs blocking '{what}' "
+                    f"while '{qname}' holds lock '{e.held[-1]}' — every "
+                    "thread that needs the lock convoys behind this wait; "
+                    "move the call outside the critical section (or waive "
+                    "inline for a deliberately serialized wait)"))
+    return out
+
+
+# ------------------------------------------------- DLT019 (interprocedural)
+def _repo_rule_thread_lifecycle(graph: "_cg.CallGraph"
+                                ) -> List[LintViolation]:
+    """DLT019: a ``threading.Thread`` started without ``daemon=True`` and
+    without a recorded ``join()``/stop path leaks on shutdown."""
+    cls_joins: Dict[str, Set[str]] = {}
+    cls_daemon: Dict[str, Set[str]] = {}
+    mod_joins: Dict[str, bool] = {}
+    for fn in graph.functions.values():
+        if fn.joins:
+            mod_joins[fn.module] = True
+        if fn.cls:
+            cls_joins.setdefault(fn.cls, set()).update(fn.joins)
+            cls_daemon.setdefault(fn.cls, set()).update(fn.daemon_sets)
+
+    out: List[LintViolation] = []
+    for qname in sorted(graph.functions):
+        fn = graph.functions[qname]
+        for th in fn.thread_starts:
+            if th.daemon in ("true", "dynamic"):
+                continue  # explicit daemon choice (dynamic: caller decides)
+            ok = False
+            if th.assigned and th.direct:
+                if th.assigned in fn.joins or th.assigned in fn.daemon_sets \
+                        or th.assigned in fn.returns:
+                    ok = True  # joined here, daemonized, or handed to caller
+                elif th.assigned.startswith("self.") and fn.cls and (
+                        th.assigned in cls_joins.get(fn.cls, ())
+                        or th.assigned in cls_daemon.get(fn.cls, ())):
+                    ok = True  # drain/stop path elsewhere in the class
+            else:
+                # pooled into a collection / comprehension: accept any join
+                # in the same function, class, or module as the stop path
+                if fn.joins or (fn.cls and cls_joins.get(fn.cls)) or \
+                        mod_joins.get(fn.module):
+                    ok = True
+            if not ok:
+                out.append(LintViolation(
+                    fn.path, th.lineno, "DLT019",
+                    f"threading.Thread started in '{qname}' without "
+                    "daemon=True or a recorded join()/stop path — a "
+                    "non-daemon thread nobody joins blocks interpreter "
+                    "exit and leaks across fleet drain/restart; set "
+                    "daemon=True, or keep the handle and join it on the "
+                    "stop path (or waive inline for a deliberately "
+                    "detached worker)"))
+    return out
+
+
 # ----------------------------------------------------------------- harness
 _RULES = (
     _rule_module_level_jnp,
@@ -1202,6 +1541,25 @@ _RULES = (
 )
 
 
+_REPO_RULES = (
+    _repo_rule_host_work_from_jit,
+    _repo_rule_cross_module_locks,
+    _repo_rule_thread_lifecycle,
+)
+
+# content-hash caches so the tier-1 gate re-lints only what changed:
+# per-file raw rule results, and the repo-rule results for a working set
+_FILE_RAW_CACHE: Dict[str, Tuple[str, List[LintViolation]]] = {}
+_REPO_RAW_CACHE: Dict[frozenset, List[LintViolation]] = {}
+
+
+def clear_caches():
+    """Drop every lint/call-graph cache (cold-run timing, tests)."""
+    _FILE_RAW_CACHE.clear()
+    _REPO_RAW_CACHE.clear()
+    _cg.clear_cache()
+
+
 def _waived(v: LintViolation, lines: List[str], file_waivers: Set[str]) -> bool:
     if v.rule in file_waivers:
         return True
@@ -1213,40 +1571,138 @@ def _waived(v: LintViolation, lines: List[str], file_waivers: Set[str]) -> bool:
     return False
 
 
-def lint_file(path: str, src: Optional[str] = None) -> List[LintViolation]:
-    if src is None:
-        with open(path, encoding="utf-8") as f:
-            src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [LintViolation(path, e.lineno or 0, "DLT000",
-                              f"syntax error: {e.msg}")]
-    lines = src.splitlines()
-    file_waivers = {
+def _parse_file_waivers(lines: List[str]) -> Set[str]:
+    return {
         part.strip().split()[0].rstrip(")")
         for line in lines if "lint: disable-file=" in line
         for part in line.split("lint: disable-file=")[1].split(",")
         if part.strip()
     }
+
+
+def _lint_file_raw(path: str, src: str) -> List[LintViolation]:
+    """All per-file rule results, UNFILTERED by waivers (the audit needs
+    the raw set to decide which waivers still suppress something)."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [LintViolation(path, e.lineno or 0, "DLT000",
+                              f"syntax error: {e.msg}")]
     out: List[LintViolation] = []
     for rule in _RULES:
         out.extend(rule(tree, src, path))
-    return sorted((v for v in out if not _waived(v, lines, file_waivers)),
-                  key=lambda v: (v.file, v.line, v.rule))
+    return out
+
+
+def lint_file(path: str, src: Optional[str] = None) -> List[LintViolation]:
+    """Per-file rules (DLT000-016) on one file; waivers applied. The
+    interprocedural families (DLT017-019) need the whole-repo graph and
+    only run under :func:`lint_paths`."""
+    if src is None:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    lines = src.splitlines()
+    return sorted(
+        (v for v in _lint_file_raw(path, src)
+         if not _waived(v, lines, _parse_file_waivers(lines))),
+        key=lambda v: (v.file, v.line, v.rule))
+
+
+def _read_and_raw(path: str) -> Tuple[str, List[LintViolation]]:
+    """(source, raw per-file violations) with content-hash caching."""
+    apath = os.path.abspath(path)
+    with open(apath, encoding="utf-8") as f:
+        src = f.read()
+    sha = hashlib.sha1(src.encode("utf-8", "replace")).hexdigest()
+    cached = _FILE_RAW_CACHE.get(apath)
+    if cached is not None and cached[0] == sha:
+        return src, cached[1]
+    raw = _lint_file_raw(apath, src)
+    _FILE_RAW_CACHE[apath] = (sha, raw)
+    return src, raw
+
+
+def _repo_raw(files: List[str]) -> List[LintViolation]:
+    """Raw (unwaived) interprocedural findings over a file working set,
+    cached on the frozenset of (path, content-hash)."""
+    graph = _cg.build_graph(files)
+    key = frozenset((s.path, s.sha) for s in graph.summaries)
+    cached = _REPO_RAW_CACHE.get(key)
+    if cached is None:
+        cached = []
+        for rule in _REPO_RULES:
+            cached.extend(rule(graph))
+        _REPO_RAW_CACHE.clear()  # one working set at a time is enough
+        _REPO_RAW_CACHE[key] = cached
+    return cached
 
 
 def lint_paths(paths: Iterable[str]) -> List[LintViolation]:
+    """Per-file rules on every file plus the interprocedural DLT017-019
+    families over the call graph of the whole working set."""
+    files = _cg.discover_files(paths)
     out: List[LintViolation] = []
-    for p in paths:
-        if os.path.isdir(p):
-            for root, dirs, files in os.walk(p):
-                dirs[:] = [d for d in dirs if d != "__pycache__"]
-                for f in sorted(files):
-                    if f.endswith(".py"):
-                        out.extend(lint_file(os.path.join(root, f)))
-        elif p.endswith(".py"):
-            out.extend(lint_file(p))
+    srcs: Dict[str, str] = {}
+    for f in files:
+        src, raw = _read_and_raw(f)
+        apath = os.path.abspath(f)
+        srcs[apath] = src
+        lines = src.splitlines()
+        out.extend(v for v in raw
+                   if not _waived(v, lines, _parse_file_waivers(lines)))
+    for v in _repo_raw(files):
+        src = srcs.get(v.file)
+        if src is None:  # finding in a file outside the lint set (unlikely)
+            out.append(v)
+            continue
+        lines = src.splitlines()
+        if not _waived(v, lines, _parse_file_waivers(lines)):
+            out.append(v)
+    return sorted(out, key=lambda v: (v.file, v.line, v.rule))
+
+
+# ------------------------------------------------------------ waiver audit
+@dataclasses.dataclass(frozen=True)
+class StaleWaiver:
+    """A ``lint: disable`` comment that no longer suppresses anything."""
+    file: str
+    line: int               # 0 for file-wide waivers
+    rules: Tuple[str, ...]  # () = bare line-waiver with no rule list
+    scope: str              # "inline" | "file"
+
+    def __str__(self):
+        what = ",".join(self.rules) or "<all>"
+        where = f"{self.file}:{self.line}" if self.scope == "inline" \
+            else self.file
+        return (f"{where}: stale waiver ({what}) — no {self.scope}-scope "
+                "finding left to suppress; delete it")
+
+
+def audit_waivers(paths: Iterable[str]) -> List[StaleWaiver]:
+    """Every waiver comment in the working set that suppresses NO raw
+    finding (per-file or interprocedural). Stale waivers hide real
+    regressions: the rule fires again one refactor later and the comment
+    swallows it silently."""
+    files = _cg.discover_files(paths)
+    raw_by_file: Dict[str, List[LintViolation]] = {}
+    for f in files:
+        _, raw = _read_and_raw(f)
+        raw_by_file.setdefault(os.path.abspath(f), []).extend(raw)
+    for v in _repo_raw(files):
+        raw_by_file.setdefault(v.file, []).append(v)
+
+    out: List[StaleWaiver] = []
+    for f in files:
+        summ = _cg.summarize_file(f)
+        raws = raw_by_file.get(summ.path, [])
+        for line, rules in sorted(summ.inline_waivers.items()):
+            hit = any(v.line == line and (not rules or v.rule in rules)
+                      for v in raws)
+            if not hit:
+                out.append(StaleWaiver(summ.path, line, rules, "inline"))
+        for rule in sorted(summ.file_waivers):
+            if not any(v.rule == rule for v in raws):
+                out.append(StaleWaiver(summ.path, 0, (rule,), "file"))
     return out
 
 

@@ -1,0 +1,3 @@
+"""DLT017 fixture package: jit entry with host work two call hops deep."""
+
+from .entry import predict  # noqa: F401

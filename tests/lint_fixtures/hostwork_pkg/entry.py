@@ -1,0 +1,12 @@
+"""The traced entry point. Its own body is clean — the hazard is two
+call hops away, which is exactly what the per-file DLT002 cannot see."""
+
+import jax
+import jax.numpy as jnp
+
+from . import stats
+
+
+@jax.jit
+def predict(x):
+    return stats.standardize(x) * jnp.float32(2.0)

@@ -1,0 +1,10 @@
+"""The middle hop: clean itself, but it pulls in hostutil."""
+
+import jax.numpy as jnp
+
+from . import hostutil
+
+
+def standardize(x):
+    scale = hostutil.drift_scale(x)
+    return (x - jnp.mean(x)) * scale

@@ -1,0 +1,15 @@
+"""DLT019 fixture: one leaked thread (non-daemon, never joined) next to
+a correctly managed twin."""
+
+import threading
+
+
+def start_unmanaged_worker(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+
+
+def start_managed_worker(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()

@@ -1,0 +1,2 @@
+"""DLT018 fixture package: opposite-order lock pair split across two
+classes in two files, each half only visible through a call edge."""

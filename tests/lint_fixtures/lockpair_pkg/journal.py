@@ -1,0 +1,22 @@
+"""Takes _journal_lock, then (via StateManager.checkpoint) _state_lock —
+the opposite order from state.py. The import cycle with state.py is
+deliberate: these files are only ever parsed, never imported, and the
+constructor assignment is what types ``self._manager`` for the graph."""
+
+import threading
+
+from .state import StateManager
+
+
+class Journal:
+    def __init__(self):
+        self._journal_lock = threading.Lock()
+        self._manager = StateManager()
+
+    def append_entry(self, line):
+        with self._journal_lock:
+            return line
+
+    def rotate(self):
+        with self._journal_lock:
+            self._manager.checkpoint("rotate")

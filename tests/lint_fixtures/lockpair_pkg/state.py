@@ -1,0 +1,19 @@
+"""Takes _state_lock, then (via Journal.append_entry) _journal_lock."""
+
+import threading
+
+from .journal import Journal
+
+
+class StateManager:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._journal = Journal()
+
+    def flush(self):
+        with self._state_lock:
+            self._journal.append_entry("flush")
+
+    def checkpoint(self, tag):
+        with self._state_lock:
+            return tag
