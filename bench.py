@@ -83,6 +83,9 @@ NOMINAL = {
     "fleet_scaleup": 10.0,      # s, nominal cold-replica time-to-ready
                                 # (restore + TuningRecord ladder warmup,
                                 # no serve-path compiles)
+    "decode": 1_000.0,          # tokens/sec, nominal GPU streaming-decode
+                                # aggregate for a small char-RNN serving
+                                # tier (~1ms/token budget)
     "pallas": 1.0,              # x, identity denominator: bench_pallas
                                 # metrics come in kernel-on/off PAIRS and
                                 # the on-arm's speedup_vs_off field is the
@@ -655,6 +658,126 @@ def bench_serving_load():
               "(b64_int8 is the quantized-endpoint wire format). "
               "metrics only — thresholds on quiet full runs per the 9p "
               "note. " % (sizes, deadline_ms) + _REPS_NOTE)
+
+
+def bench_decode():
+    """Generative decode tier: aggregate tokens/sec with N concurrent
+    sessions through the continuous-batching DecodeEngine (one jitted
+    step advances every active session per dispatch, sessions joining at
+    token boundaries) vs the sequential per-session ``rnn_time_step``
+    loop on the SAME model — the measured-throughput gap ISSUE 19 exists
+    to close. Also reports client-side time-to-first-token and
+    inter-token-latency p50/p99 and the steady-state compile count
+    (must be zero: every program warmed before the measured wave)."""
+    import threading
+
+    from deeplearning4j_tpu.models.textgenlstm import TextGenerationLSTM
+    from deeplearning4j_tpu.serving.decode import DecodeEngine
+
+    vocab = 16 if QUICK else 64
+    units = 16 if QUICK else 96
+    n_sessions = 8 if QUICK else 32
+    gen_tokens = 16 if QUICK else 128
+    prompt_len = 3 if QUICK else 12
+    net = TextGenerationLSTM(total_unique_characters=vocab, units=units,
+                             seed=7).init()
+    rng = np.random.default_rng(0)
+    prompts = [[int(t) for t in rng.integers(0, vocab, prompt_len)]
+               for _ in range(n_sessions)]
+
+    # ---- baseline: sequential greedy decode, one session at a time on
+    # the stateful host API (prefill = step through the prompt, then
+    # closed-loop argmax) — warmed first so both sides are steady-state
+    def one_hot(tok):
+        x = np.zeros((1, vocab), np.float32)
+        x[0, tok] = 1.0
+        return x
+
+    net.rnn_clear_previous_state()
+    net.rnn_time_step(one_hot(0))
+    net.rnn_clear_previous_state()
+
+    def seq_run():
+        t0 = time.perf_counter()  # lint: disable=DLT003 (rnn_time_step returns HOST numpy — every step in the loop is already a device sync; int(argmax) consumes it)
+        for prompt in prompts:
+            net.rnn_clear_previous_state()
+            for tok in prompt:
+                out = net.rnn_time_step(one_hot(tok))
+            cur = int(out[0].argmax())  # generated token 1
+            for _ in range(gen_tokens - 1):
+                out = net.rnn_time_step(one_hot(cur))
+                cur = int(out[0].argmax())
+        return time.perf_counter() - t0  # rnn_time_step returns host np
+
+    seq_s = _best_of(seq_run)
+    seq_tps = n_sessions * gen_tokens / seq_s
+
+    # ---- continuous batching: N concurrent sessions, temperature 0
+    # (greedy — the same per-token work as the baseline)
+    engine = DecodeEngine(net, max_sessions=n_sessions,
+                          min_slots=min(8, n_sessions),
+                          prefill_buckets=(4, 16) if QUICK else (16, 64),
+                          seed=1)
+    engine.warmup()
+    compiles_before = dict(engine.stats()["compiles"])
+
+    def wave():
+        ttfts, itls = [], []
+
+        def consume(sess, opened):
+            last = None
+            for ev in sess.events(token_deadline_s=120.0):
+                now = time.perf_counter()
+                if ev["type"] != "token":
+                    continue
+                if last is None:
+                    ttfts.append((now - opened) * 1e3)
+                else:
+                    itls.append((now - last) * 1e3)
+                last = now
+
+        threads = []
+        t0 = time.perf_counter()  # lint: disable=DLT003 (clocks time CLIENT-side event arrival off the streaming queue — the engine worker's bulk readback synced the device before each event was emitted)
+        for prompt in prompts:
+            sess = engine.open_session(prompt, max_tokens=gen_tokens,
+                                       temperature=0.0)
+            th = threading.Thread(target=consume,
+                                  args=(sess, time.perf_counter()),
+                                  daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=300.0)
+        return time.perf_counter() - t0, ttfts, itls
+
+    best = None
+    for _ in range(REPS):
+        elapsed, ttfts, itls = wave()
+        if best is None or elapsed < best[0]:
+            best = (elapsed, ttfts, itls)
+    eng_s, ttfts, itls = best
+    eng_tps = n_sessions * gen_tokens / eng_s
+    compiles_after = dict(engine.stats()["compiles"])
+    steady = sum(compiles_after.values()) - sum(compiles_before.values())
+    engine.stop()
+
+    emit("decode_tokens_per_sec", eng_tps, "tokens/sec", "decode",
+         sessions=n_sessions, tokens_per_session=gen_tokens,
+         sequential_tokens_per_sec=round(seq_tps, 1),
+         speedup_vs_sequential=round(eng_tps / seq_tps, 2),
+         ttft_ms={"p50": round(float(np.percentile(ttfts, 50)), 2),
+                  "p99": round(float(np.percentile(ttfts, 99)), 2)},
+         itl_ms={"p50": round(float(np.percentile(itls, 50)), 2),
+                 "p99": round(float(np.percentile(itls, 99)), 2)}
+         if itls else None,
+         compiles=compiles_after, steady_state_compiles=steady,
+         note="aggregate greedy decode throughput, %d concurrent "
+              "sessions x %d tokens through the device-resident session "
+              "ladder vs the SAME model decoded sequentially per session "
+              "via rnn_time_step; steady_state_compiles counts programs "
+              "compiled during the measured wave (0 = every dispatch "
+              "replayed a warmed program). " % (n_sessions, gen_tokens)
+              + _REPS_NOTE)
 
 
 def bench_fleet():
@@ -1744,6 +1867,7 @@ def main():
     benches = [("lenet", bench_lenet), ("word2vec", bench_word2vec),
                ("charlstm", bench_graveslstm), ("serving", bench_serving),
                ("serving_load", bench_serving_load),
+               ("decode", bench_decode),
                ("fleet", bench_fleet),
                ("checkpoint", bench_checkpoint),
                ("resilience", bench_resilience),

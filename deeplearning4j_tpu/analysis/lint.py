@@ -163,6 +163,18 @@ Rules (waivable per line with ``# lint: disable=DLT00X`` or per file with
   timeout argument counts; waivable inline for a deliberately unbounded
   wait.
 
+- **DLT020 per-token-host-transfer**: in ``serving/`` + ``nn/`` paths,
+  a host transfer (``np.*`` call, ``jax.device_get``, ``.item()``,
+  ``.tolist()``) inside a LOOP body of a decode/sampling-shaped function
+  (name mentions decode/sample/generate/stream/token) that also uses
+  jnp/lax device math. The generative tier's contract is ONE device
+  dispatch advancing every active session and ONE bulk readback per
+  dispatch — a transfer inside the per-token loop reintroduces the
+  per-session host round-trip continuous batching exists to kill
+  (sessions × tokens syncs instead of one per step). Transfers outside
+  loops (the single bulk read) are fine; waivable inline for a
+  deliberately host-side helper.
+
 Interprocedural rule families (DLT017-019) run over the whole-repo call
 graph built by ``analysis/callgraph.py`` — they only fire from
 ``lint_paths`` (and the ``tools/run_lint.py`` CLI), never from
@@ -1279,6 +1291,86 @@ def _rule_blocking_io_without_timeout(tree, src, path
     return out
 
 
+# ------------------------------------------------------------------ DLT020
+_DECODE_TOKENS = ("decode", "sample", "generate", "stream", "token")
+
+
+def _is_serving_nn_path(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return any(seg in p for seg in ("serving/", "nn/"))
+
+
+def _rule_per_token_host_transfer(tree, src, path) -> List[LintViolation]:
+    """DLT020: host transfers inside loop bodies of decode/sampling
+    functions in serving/ + nn/ paths. The decode tier's contract is one
+    jitted dispatch advancing EVERY active session and one bulk readback
+    per dispatch; ``device_get``/``.item()``/``np.*``/``.tolist()``
+    inside the per-token loop turns that into sessions × tokens host
+    syncs — the exact collapse continuous batching exists to kill."""
+    if not _is_serving_nn_path(path):
+        return []
+    aliases = _import_aliases(tree)
+    out: List[LintViolation] = []
+
+    def uses_device_math(fn) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Attribute, ast.Name)):
+                q = _resolve(_dotted(node), aliases)
+                if q.startswith(("jax.numpy", "jax.lax", "jax.nn",
+                                 "jax.random")):
+                    return True
+        return False
+
+    def in_scope_functions():
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            name = node.name.lower()
+            if any(t in name for t in _DECODE_TOKENS) \
+                    and uses_device_math(node):
+                yield node
+
+    def hazard_of(node: ast.Call) -> Optional[str]:
+        q = _resolve(_dotted(node.func), aliases)
+        if q == "numpy" or q.startswith("numpy."):
+            return f"'{q}(...)' (host numpy)"
+        if q == "jax.device_get":
+            return "'jax.device_get(...)'"
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("item", "tolist"):
+            return f"'.{node.func.attr}()'"
+        return None
+
+    # dedup on the CALL node (the DLT013 nested-function note); nested
+    # loops also walk inner statements twice — same guard covers both
+    seen_calls: Set[int] = set()
+    for fn in in_scope_functions():
+        for loop in ast.walk(fn):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            for stmt in loop.body + loop.orelse:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call) \
+                            or id(node) in seen_calls:
+                        continue
+                    hazard = hazard_of(node)
+                    if hazard is None:
+                        continue
+                    seen_calls.add(id(node))
+                    out.append(LintViolation(
+                        path, node.lineno, "DLT020",
+                        f"{hazard} inside a loop body of decode/sampling "
+                        f"function '{fn.name}' — the decode tier makes "
+                        "ONE jitted dispatch advance every active "
+                        "session with ONE bulk readback per dispatch; a "
+                        "host transfer inside the per-token loop "
+                        "reintroduces sessions x tokens host syncs (the "
+                        "per-call rnn_time_step collapse); hoist the "
+                        "readback out of the loop (or waive inline for "
+                        "a deliberately host-side helper)"))
+    return out
+
+
 # ------------------------------------------------- DLT017 (interprocedural)
 # consequence phrasing per hazard kind, for the message
 _DLT017_REASON = {
@@ -1538,6 +1630,7 @@ _RULES = (
     _rule_host_nibble_unpack,
     _rule_host_work_in_pallas_kernel,
     _rule_blocking_io_without_timeout,
+    _rule_per_token_host_transfer,
 )
 
 

@@ -151,18 +151,27 @@ def restore_and_serve(store, models: List[Tuple[str, str]], *,
                       default_deadline_ms: float = 1000.0,
                       poll_secs: Optional[float] = None,
                       ttl_s: float = DEFAULT_TTL_S,
-                      wait_ready_s: float = 300.0) -> "ServingReplica":
+                      wait_ready_s: float = 300.0,
+                      compile_cache_dir: Optional[str] = None
+                      ) -> "ServingReplica":
     """Subprocess-shaped replica bring-up: restore each ``(name,
     ckpt_dir)`` model's latest checkpoint (inheriting any ``TuningRecord``
     riding it — warmup then compiles the exact serving ladder), register
     everything on a fresh ModelServer, start and announce. Returns the
-    running replica; the caller owns the lifetime (``stop()``)."""
+    running replica; the caller owns the lifetime (``stop()``).
+
+    ``compile_cache_dir`` points JAX's persistent compilation cache at a
+    shared directory (``perf.compile_cache``): the SECOND cold start of
+    a replica replays its warmup executables from disk instead of
+    re-running XLA — the instant-start lever on top of the warmed
+    TuningRecord ladder."""
     from deeplearning4j_tpu.checkpoint import CheckpointManager
     from deeplearning4j_tpu.serving import ModelServer
 
     server = ModelServer(port=port, bind_address=bind_address,
                          queue_depth=queue_depth, batch_limit=batch_limit,
-                         default_deadline_ms=default_deadline_ms)
+                         default_deadline_ms=default_deadline_ms,
+                         compile_cache_dir=compile_cache_dir)
     managers = []
     for name, ckpt_dir in models:
         cm = CheckpointManager(ckpt_dir)

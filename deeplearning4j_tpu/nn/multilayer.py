@@ -551,26 +551,36 @@ class MultiLayerNetwork:
 
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
-    def rnn_time_step(self, x) -> np.ndarray:
-        """Stateful step-by-step inference (reference
-        MultiLayerNetwork.rnnTimeStep :2615): carries (h, c) across calls."""
+    def _check_stateful(self):
         for layer in self.layers:
             if not getattr(layer, "supports_stateful", True):
                 raise NotImplementedError(
                     f"rnn_time_step is not supported with {type(layer).__name__}: "
                     "the backward direction needs the full sequence (reference "
                     "GravesBidirectionalLSTM.rnnTimeStep throws the same)")
-        x = jnp.asarray(x)
+
+    def rnn_time_step(self, x) -> np.ndarray:
+        """Stateful step-by-step inference (reference
+        MultiLayerNetwork.rnnTimeStep :2615): carries (h, c) across calls.
+
+        Single-timestep calls — the autoregressive decode shape — ride the
+        SAME jitted single-step program the serving decode tier uses
+        (``rnn_single_step``): the time axis is added inside the trace, so
+        every step after the first dispatches one warmed program with no
+        per-call tracing or host-side reshaping. Multi-timestep inputs
+        keep the full-sequence ``rnn_step`` program."""
+        self._check_stateful()
+        x = np.asarray(x)
         squeeze = False
-        if getattr(self.layers[0], "takes_index_sequence", False):
+        index_seq = getattr(self.layers[0], "takes_index_sequence", False)
+        if index_seq:
             if x.ndim == 1:  # single timestep of ids (batch,)
-                x = x[:, None]
                 squeeze = True
             elif x.ndim == 2 and x.shape[1] == 1:
+                x = x[:, 0]
                 squeeze = True
             # else: (batch, time) id sequence — already has a time axis
         elif x.ndim == 2:  # single timestep (batch, features)
-            x = x[:, None, :]
             squeeze = True
         b = x.shape[0]
         if self._rnn_carries is None:
@@ -581,10 +591,58 @@ class MultiLayerNetwork:
                 raise ValueError(
                     f"rnn_time_step batch size {b} does not match stored state "
                     f"batch {leaves[0].shape[0]}; call rnn_clear_previous_state() first")
+        if squeeze:
+            fn = self._get_jitted("rnn_single_step")
+            out, self._rnn_carries = fn(self.params, self.state,
+                                        self._rnn_carries, jnp.asarray(x))
+            return np.asarray(out)
         fn = self._get_jitted("rnn_step")
-        out, self._rnn_carries = fn(self.params, self.state, self._rnn_carries, x)
-        out = np.asarray(out)
-        return out[:, -1, :] if (squeeze and out.ndim == 3) else out
+        out, self._rnn_carries = fn(self.params, self.state,
+                                    self._rnn_carries, jnp.asarray(x))
+        return np.asarray(out)
+
+    def decode_step_fn(self):
+        """Single-step decode lowering for the serving tier
+        (serving/decode.py): returns ``f(params, state, carries, tokens)``
+        with ``tokens`` a ``(batch,)`` int32 id vector, producing
+        ``(logits, new_carries)`` where ``logits`` is the output layer's
+        f32 PRE-activation ``(batch, n_out)`` — the sampling input. Token
+        ids are mapped to the network's input encoding IN-GRAPH (embedding
+        gather for index-sequence nets, one-hot for distribution-input
+        nets), so the caller never materializes features on the host. The
+        returned callable is pure and jit-ready; the engine owns jitting
+        and CompileWatch wrapping."""
+        self._check_stateful()
+        out_layer = self.layers[-1]
+        if not out_layer.is_output_layer():
+            raise ValueError("decode_step_fn needs an output layer last "
+                             "(RnnOutputLayer) to expose sampling logits")
+        index_seq = getattr(self.layers[0], "takes_index_sequence", False)
+        n_in = self.conf.layer_input_types()[0].size
+
+        def step(params, state, carries, tokens):
+            ids = tokens.astype(jnp.int32)
+            if index_seq:
+                x = ids[:, None]                              # (b, 1) ids
+            else:
+                x = jax.nn.one_hot(ids, n_in,
+                                   dtype=jnp.float32)[:, None, :]
+            _, preout, _, _, new_carries = self._forward(
+                params, state, x, False, None, None, carries)
+            if not hasattr(preout, "shape"):
+                raise ValueError(
+                    "decode_step_fn needs a plain-tensor output layer; "
+                    f"{type(out_layer).__name__} produces a structured "
+                    "pre-output")
+            return preout[:, 0, :].astype(jnp.float32), new_carries
+
+        return step
+
+    def decode_vocab_size(self) -> int:
+        """Token-id space of the decode loop: the input size (one-hot
+        width / embedding vocab). The output layer's n_out must match it
+        for closed-loop generation; serving/decode.py enforces that."""
+        return int(self.conf.layer_input_types()[0].size)
 
     def rnn_clear_previous_state(self):
         """reference MultiLayerNetwork.rnnClearPreviousState."""
@@ -613,6 +671,21 @@ class MultiLayerNetwork:
                              (lambda r: (r[0][-1], r[4]))(
                                  self._forward(params, state, x, False, None,
                                                None, carries)))
+            elif kind == "rnn_single_step":
+                # one decode timestep: x has NO time axis ((b,) ids or
+                # (b, f) features) — it is added inside the trace and the
+                # output squeezed back, so rnn_time_step and the serving
+                # decode tier share one warmed program shape per batch
+                index_seq = getattr(self.layers[0], "takes_index_sequence",
+                                    False)
+
+                def single_step(params, state, carries, x):
+                    xt = x[:, None] if index_seq else x[:, None, :]
+                    r = self._forward(params, state, xt, False, None, None,
+                                      carries)
+                    return r[0][-1][:, 0, :], r[4]
+
+                fn = jax.jit(single_step)
             elif kind == "output":
                 fn = jax.jit(lambda params, state, x, fmask:
                              self._forward(params, state, x, False, None, fmask)[0][-1])

@@ -9,6 +9,9 @@ Three cooperating pieces (see each module's docstring):
                       device placement of batch N+1 while step N runs;
 - ``compile_watch`` — CompileWatch: compile/dispatch counters so tests and
                       benches can assert "N batches, 1 compile";
+- ``compile_cache`` — persisted XLA compilation cache for serving cold
+                      starts (second bring-up replays executables from
+                      disk), with an observable cache-hit counter;
 - ``fusion``        — fuse/fuse_network (Conv→BN→Act fused blocks with a
                       memory-efficient custom VJP — 2-D, separable and 1-D
                       heads), fold_bn (inference-time BN folding, residual
@@ -32,6 +35,10 @@ from deeplearning4j_tpu.perf.bucketing import (  # noqa: F401
     pad_multi_dataset,
     pad_to_bucket,
     unpad,
+)
+from deeplearning4j_tpu.perf.compile_cache import (  # noqa: F401
+    cache_hits,
+    enable_compilation_cache,
 )
 from deeplearning4j_tpu.perf.compile_watch import (  # noqa: F401
     GLOBAL as GLOBAL_COMPILE_WATCH,

@@ -1,0 +1,84 @@
+"""Persisted XLA compilation cache for serving cold starts.
+
+The warmed TuningRecord bucket ladder (PR 13) removes serve-time
+compiles but a fresh process still pays every warmup compile from
+scratch. Pointing JAX's persistent compilation cache at a directory
+makes the SECOND cold start replay executables from disk instead of
+re-running XLA — the fleet's instant-start story gets a second lever
+beyond lease-gated warmup.
+
+``enable_compilation_cache(dir)`` is process-global and idempotent; the
+thresholds are dropped to zero so even the small CPU-test programs cache
+(the default config skips sub-second compiles, which on TPU is fine but
+would make the cold-start test meaningless). Cache *hits* are observable
+via :func:`cache_hits`, fed by a ``jax.monitoring`` event listener —
+that is what the cold-start test asserts on.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+__all__ = ["enable_compilation_cache", "cache_hits", "cache_dir"]
+
+_lock = threading.Lock()
+_dir: Optional[str] = None
+_hits = 0
+_listener_installed = False
+
+
+def _on_event(name: str, **kwargs):
+    global _hits
+    if name == "/jax/compilation_cache/cache_hits":
+        with _lock:
+            _hits += 1
+
+
+def enable_compilation_cache(directory, *,
+                             min_compile_time_secs: float = 0.0) -> str:
+    """Point JAX's persistent compilation cache at ``directory``
+    (created on first write). Process-global; calling again with the
+    same directory is a no-op, with a different one re-points the cache
+    and logs. Returns the directory."""
+    global _dir, _listener_installed
+    import jax
+
+    directory = str(directory)
+    with _lock:
+        if _dir == directory:
+            return directory
+        if _dir is not None:
+            log.warning("compilation cache re-pointed: %s -> %s",
+                        _dir, directory)
+    jax.config.update("jax_compilation_cache_dir", directory)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_time_secs))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    with _lock:
+        _dir = directory
+        if not _listener_installed:
+            try:
+                import jax.monitoring as monitoring
+                monitoring.register_event_listener(_on_event)
+                _listener_installed = True
+            except Exception:  # pragma: no cover - older jax
+                log.warning("jax.monitoring unavailable; cache_hits() "
+                            "will stay 0")
+    log.info("persistent compilation cache enabled at %s", directory)
+    return directory
+
+
+def cache_hits() -> int:
+    """Number of persistent-cache hits observed this process (compiles
+    answered from disk instead of XLA)."""
+    with _lock:
+        return _hits
+
+
+def cache_dir() -> Optional[str]:
+    with _lock:
+        return _dir

@@ -9,11 +9,25 @@ graceful drain (zero dropped in-flight), warmup-gated readiness and a
 ``/metrics`` scrape of every control point. See
 :mod:`~deeplearning4j_tpu.serving.server` for the route table and
 :mod:`~deeplearning4j_tpu.serving.breaker` for the breaker state machine.
+
+The generative tier (:mod:`~deeplearning4j_tpu.serving.decode`) adds
+continuous-batching autoregressive decode behind
+``POST /v1/models/<name>:generate``: per-session recurrent state lives
+device-resident in a pow2 session-slot ladder, one jitted step advances
+every active session per dispatch, and tokens stream back as SSE with
+the same admission taxonomy.
 """
 
 from deeplearning4j_tpu.serving.breaker import CircuitBreaker  # noqa: F401
+from deeplearning4j_tpu.serving.decode import (  # noqa: F401
+    DecodeEngine,
+    DecodeSession,
+    EngineStoppedError,
+    SessionLimitError,
+)
 from deeplearning4j_tpu.serving.server import (  # noqa: F401
     BreakerOpenError,
+    GenerateEndpoint,
     ModelDispatchError,
     ModelEndpoint,
     ModelServer,

@@ -1,0 +1,740 @@
+"""Generative decode tier: device-resident session state, continuous
+session batching, token streaming.
+
+The reference's ``rnnTimeStep`` decode API serves ONE session per call
+with a host round-trip per token. This module turns the same recurrent
+step into a serving tier:
+
+- **Device-resident session state.** Every live session owns one slot in
+  a device-resident state block: the LSTM carries (h, c — the KV-cache
+  shape, LSTM edition) with leading axis = slot capacity, plus per-slot
+  last-token / temperature / top-k / active lanes. Capacity is a pow2
+  **slot ladder** (min_slots, 2·min_slots, ... ≥ max_sessions): growth
+  jumps to the next rung, and :meth:`DecodeEngine.warmup` pre-compiles
+  every rung so growth never compiles at serve time.
+- **Continuous session batching.** ONE jitted step advances every active
+  slot per dispatch. Sessions join/leave only at token boundaries, by
+  scatter-writing (join) or flag-clearing (clear) their slot — both are
+  themselves warmed jitted programs with the slot index traced, so the
+  steady state compiles NOTHING (CompileWatch-asserted in tests).
+- **On-device sampling.** Temperature/top-k sampling runs inside the
+  step off a device PRNG key that never leaves the device; the only
+  host transfer per dispatch is the bulk (S,) sampled-token vector
+  (trace_check-asserted: syncs scale with steps, not sessions×tokens).
+  ``temperature <= 0`` means argmax-greedy — deterministic, used by the
+  parity tests against sequential ``rnn_time_step``.
+- **Prefill buckets.** Prompts run through right-padded pow2 length
+  buckets with a feature mask; the LSTM mask semantics hold the carry
+  through padded steps, so the final carry equals the carry after the
+  real prompt. Longer prompts chunk through the largest bucket with the
+  carry threaded — the same path re-prefills a session after a
+  checkpoint hot-swap under ``policy="reprefill"``.
+- **Checkpoint hot-swap.** :meth:`start_hot_swap` polls a
+  CheckpointManager like ``ParallelInference``; a newer checkpoint is
+  restored OFF-PATH and the swap is applied by the decode worker
+  between dispatches — sessions either carry their state across the
+  swap (``policy="carry"``, default) or are re-prefilled from
+  prompt+generated under the new params (``policy="reprefill"``).
+
+Host-side rule (lint DLT020): nothing in the per-token path reads the
+device. The worker fetches the sampled-token vector once per dispatch
+(:func:`_host_read`) and all delivery/bookkeeping below that point
+iterates over host numpy.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import queue
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.parallel.inference import QueueFullError
+
+log = logging.getLogger(__name__)
+
+__all__ = ["DecodeEngine", "DecodeSession", "SessionLimitError",
+           "EngineStoppedError"]
+
+_session_ids = itertools.count(1)
+
+
+class SessionLimitError(QueueFullError):
+    """Admission refused: every slot the engine may grow to is occupied.
+    Subclasses QueueFullError so the server's 429 mapping applies."""
+
+
+class EngineStoppedError(RuntimeError):
+    """open_session() on a stopped or draining engine — maps to 503."""
+
+
+def _pow2_at_least(n: int) -> int:
+    return 1 << max(0, (int(n) - 1).bit_length())
+
+
+def _sample_next(logits, temps, topks, key):
+    """In-graph temperature/top-k sampling, one row per slot. Pure jnp:
+    top-k threshold via a descending sort, -inf mask, temperature
+    scaling, then ``jax.random.categorical`` (independent per row).
+    ``topk <= 0`` disables the top-k cut; ``temp <= 0`` selects argmax."""
+    v = logits.shape[-1]
+    desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    k_idx = jnp.clip(jnp.where(topks > 0, topks, v), 1, v) - 1
+    kth = jnp.take_along_axis(desc, k_idx[:, None], axis=-1)
+    masked = jnp.where(logits < kth, -jnp.inf, logits)
+    scaled = masked / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
+
+
+def _host_read(arr) -> np.ndarray:
+    """The ONE host transfer per decode dispatch: the bulk (S,) sampled
+    vector. Everything below this point in the delivery path is host
+    numpy — never a per-session device read."""
+    return np.asarray(arr)
+
+
+class DecodeSession:
+    """One generative stream: admission parameters, the generated-id
+    history, and a bounded event queue the transport drains.
+
+    Events are dicts: ``{"type": "token", "id": int, "index": int,
+    "text": str|None}``, ``{"type": "done", "reason": str, "tokens":
+    int}`` or ``{"type": "error", "error": str, "message": str}``. A
+    terminal event (done/error) is always the last one delivered —
+    a stream never silently stalls."""
+
+    def __init__(self, prompt_ids: Sequence[int], *, max_tokens: int,
+                 temperature: float, top_k: int, eos_id: Optional[int],
+                 engine: "DecodeEngine"):
+        self.id = f"s{next(_session_ids)}"
+        self.prompt_ids = [int(i) for i in prompt_ids]
+        self.max_tokens = int(max_tokens)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.eos_id = eos_id if eos_id is None else int(eos_id)
+        self.generated: List[int] = []
+        self.opened_at = time.monotonic()
+        self.first_token_at: Optional[float] = None
+        self._last_token_at: Optional[float] = None
+        self._engine = engine
+        self._cancelled = False
+        self._finished = False
+        self._synthetic = False  # warmup priming: excluded from metrics
+        self.slot: Optional[int] = None
+        # bounded (DLT008): max_tokens token events + one terminal event
+        self._events: "queue.Queue[dict]" = queue.Queue(
+            maxsize=self.max_tokens + 8)
+
+    # ------------------------------------------------------------- consumer
+    def next_event(self, timeout_s: Optional[float] = None) -> Optional[dict]:
+        """Blocking read of the next event; ``None`` means the timeout
+        elapsed with the engine silent — the caller owns the deadline
+        semantics (the HTTP layer turns it into a typed error event)."""
+        try:
+            return self._events.get(timeout=timeout_s)
+        except queue.Empty:
+            return None
+
+    def events(self, token_deadline_s: Optional[float] = None):
+        """Iterate events until the terminal one. A token that misses
+        ``token_deadline_s`` terminates the iteration with a typed
+        ``error`` event (and cancels the session) — never a silent
+        stall."""
+        while True:
+            ev = self.next_event(token_deadline_s)
+            if ev is None:
+                self.cancel()
+                yield {"type": "error", "error": "token_deadline_expired",
+                       "message": f"no token within {token_deadline_s}s"}
+                return
+            yield ev
+            if ev["type"] in ("done", "error"):
+                return
+
+    def cancel(self):
+        """Mark for retirement; the decode worker clears the slot at the
+        next token boundary. Idempotent, callable from any thread."""
+        self._cancelled = True
+        self._engine._nudge()
+
+    @property
+    def finished(self) -> bool:
+        return self._finished
+
+    # ----------------------------------------------- engine-side delivery
+    def _emit(self, ev: dict):
+        try:
+            self._events.put_nowait(ev)
+        except queue.Full:  # consumer gone; retire via cancel path
+            self._cancelled = True
+
+    def _finish(self, reason: str):
+        self._finished = True
+        self._emit({"type": "done", "reason": reason,
+                    "tokens": len(self.generated)})
+
+
+class DecodeEngine:
+    """Continuous-batching autoregressive decode over one network.
+
+    The engine owns the device session block and a single worker thread
+    that admits pending sessions, dispatches the jitted step, and
+    delivers sampled tokens — all device-state mutation happens on that
+    thread, so joins/leaves/swaps land exactly at token boundaries."""
+
+    def __init__(self, net, *, max_sessions: int = 64, min_slots: int = 8,
+                 prefill_buckets: Sequence[int] = (16, 64, 256),
+                 seed: int = 0, vocab: Optional[Sequence[str]] = None):
+        self._net = net
+        self._step_fn = net.decode_step_fn()
+        self._watch = net.compile_watch
+        self.vocab_size = net.decode_vocab_size()
+        n_out = getattr(net.layers[-1], "n_out", None)
+        if n_out is not None and int(n_out) != self.vocab_size:
+            raise ValueError(
+                f"closed-loop decode needs n_out == input vocab; got "
+                f"n_out={n_out} vs vocab={self.vocab_size}")
+        self.vocab = list(vocab) if vocab is not None else None
+        self.max_sessions = int(max_sessions)
+        min_slots = _pow2_at_least(min(min_slots, self.max_sessions))
+        self._rungs: List[int] = []
+        s = min_slots
+        while True:
+            self._rungs.append(s)
+            if s >= self.max_sessions:
+                break
+            s *= 2
+        self._buckets = sorted(_pow2_at_least(b) for b in prefill_buckets)
+        self._params = net.params
+        self._state = net.state
+        self._carry1 = net._zero_carries(1)
+        self._key = jax.random.PRNGKey(seed)
+
+        self._lock = threading.RLock()
+        self._work = threading.Condition(self._lock)
+        self._cap_idx = 0
+        self._dstate = self._init_dstate(self._rungs[0])
+        self._free: List[int] = list(range(self._rungs[0]))
+        self._slots: Dict[int, DecodeSession] = {}
+        self._pending: "deque[DecodeSession]" = deque()
+        self._sessions: Dict[str, DecodeSession] = {}
+        self._running = False
+        self._draining = False
+        self._warmed = False
+        self._worker: Optional[threading.Thread] = None
+        self._steps = 0
+
+        # hot-swap
+        self._swap_cm = None
+        self._swap_policy = "carry"
+        self._swap_seen_step: Optional[int] = None
+        self._pending_swap: Optional[Tuple[object, object, int]] = None
+        self._swap_count = 0
+        self._swap_thread: Optional[threading.Thread] = None
+        self._swap_stop = threading.Event()
+
+        self._progs: Dict[str, object] = {}
+        self._init_metrics()
+
+    # ------------------------------------------------------------- metrics
+    def _init_metrics(self):
+        from deeplearning4j_tpu.obs.registry import get_registry
+        reg = get_registry()
+        self._m_active = reg.gauge(
+            "decode_sessions_active", unit="sessions",
+            help="live decode sessions holding a device slot")
+        self._m_tokens = reg.counter(
+            "decode_tokens_total", unit="tokens",
+            help="tokens sampled and delivered across all decode sessions")
+        self._m_steps = reg.counter(
+            "decode_steps_total", unit="dispatches",
+            help="batched decode step dispatches (all active slots "
+                 "advance one token per dispatch)")
+        self._m_ttft = reg.histogram(
+            "decode_ttft_ms", unit="ms",
+            help="time to first token: session open to first delivery")
+        self._m_itl = reg.histogram(
+            "decode_itl_ms", unit="ms",
+            help="inter-token latency between consecutive deliveries "
+                 "of one session")
+        self._m_swaps = reg.counter(
+            "decode_hot_swaps_total", unit="swaps",
+            help="checkpoint hot-swaps applied at a token boundary")
+
+    # -------------------------------------------------------- device state
+    def _init_dstate(self, cap: int):
+        carries = self._net._zero_carries(cap)
+        return (carries,
+                jnp.zeros((cap,), dtype=jnp.int32),
+                jnp.ones((cap,), dtype=jnp.float32),
+                jnp.zeros((cap,), dtype=jnp.int32),
+                jnp.zeros((cap,), dtype=jnp.bool_))
+
+    @property
+    def capacity(self) -> int:
+        return self._rungs[self._cap_idx]
+
+    # ------------------------------------------------------ jitted programs
+    def _prog(self, kind: str):
+        """One wrapped jitted program per kind; rung/bucket shapes are
+        plain shape specializations of the same program, pre-compiled by
+        warmup so neither growth nor any steady-state dispatch compiles."""
+        fn = self._progs.get(kind)
+        if fn is not None:
+            return fn
+        step_fn = self._step_fn
+        if kind == "step":
+            def prog(params, state, dstate, key):
+                carries, tokens, temps, topks, active = dstate
+                logits, new_carries = step_fn(params, state, carries, tokens)
+                key, sub = jax.random.split(key)
+                nxt = _sample_next(logits, temps, topks, sub)
+                return ((new_carries, nxt, temps, topks, active), nxt, key)
+        elif kind == "join":
+            def prog(dstate, slot, carry, token, temp, topk):
+                carries, tokens, temps, topks, active = dstate
+                nc = jax.tree_util.tree_map(
+                    lambda a, b: a.at[slot].set(b[0]), carries, carry)
+                return (nc, tokens.at[slot].set(token),
+                        temps.at[slot].set(temp),
+                        topks.at[slot].set(topk),
+                        active.at[slot].set(True))
+        elif kind == "clear":
+            def prog(dstate, slot):
+                carries, tokens, temps, topks, active = dstate
+                return (carries, tokens, temps, topks,
+                        active.at[slot].set(False))
+        elif kind == "grow":
+            def prog(dstate):
+                def pad(a):
+                    return jnp.concatenate([a, jnp.zeros_like(a)], axis=0)
+                carries, tokens, temps, topks, active = dstate
+                return (jax.tree_util.tree_map(pad, carries), pad(tokens),
+                        pad(temps), pad(topks), pad(active))
+        elif kind == "prefill":
+            net = self._net
+            index_seq = getattr(net.layers[0], "takes_index_sequence", False)
+            n_in = self.vocab_size
+
+            def prog(params, state, ids, length, carry, temp, topk, key):
+                t = ids.shape[1]
+                x = ids if index_seq else jax.nn.one_hot(
+                    ids, n_in, dtype=jnp.float32)
+                fmask = (jnp.arange(t)[None, :] < length).astype(jnp.float32)
+                _, preout, _, _, new_carries = net._forward(
+                    params, state, x, False, None, fmask, carry)
+                idx = jnp.reshape(length - 1, (1, 1, 1)).astype(jnp.int32)
+                last = jnp.take_along_axis(
+                    preout, idx, axis=1)[:, 0, :].astype(jnp.float32)
+                key, sub = jax.random.split(key)
+                tok = _sample_next(last, temp[None], topk[None], sub)[0]
+                return new_carries, tok, key
+        else:
+            raise KeyError(kind)
+        fn = self._watch.wrap(jax.jit(prog), f"decode.{kind}")
+        self._progs[kind] = fn
+        return fn
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "DecodeEngine":
+        with self._work:
+            if self._running:
+                return self
+            self._running = True
+        self._worker = threading.Thread(target=self._run_loop,
+                                        name="decode-worker", daemon=True)
+        self._worker.start()
+        return self
+
+    def warmup(self):
+        """Compile the full slot ladder (step/join/clear at every rung,
+        grow at every rung transition) and every prefill bucket, then run
+        a synthetic priming wave through the live worker — max_sessions
+        short sessions that grow the ladder to its top rung end-to-end.
+        After priming, capacity sits at the top rung (it is a high-water
+        mark and never shrinks), so every serve-time dispatch — step,
+        join, clear, prefill — replays a program the warmup already
+        compiled: the steady state compiles NOTHING. Priming sessions
+        are marked synthetic and excluded from the serving metrics."""
+        params, state = self._params, self._state
+        key = jax.random.PRNGKey(0)
+        step, join, clear, grow = (self._prog("step"), self._prog("join"),
+                                   self._prog("clear"), self._prog("grow"))
+        pf = self._prog("prefill")
+        # prefill first: its carry/token outputs are the exact arguments
+        # the serve-time join receives, so the join signature warmed here
+        # is the one admission dispatches
+        carry, tok = self._carry1, None
+        for b in self._buckets:
+            ids = jnp.zeros((1, b), dtype=jnp.int32)
+            carry, tok, key = pf(params, state, ids, np.int32(1),
+                                 self._carry1, np.float32(1.0),
+                                 np.int32(0), key)
+        ds = self._init_dstate(self._rungs[0])
+        for i, cap in enumerate(self._rungs):
+            ds2 = join(ds, np.int32(0), carry, tok,
+                       np.float32(1.0), np.int32(0))
+            ds2, toks, key = step(params, state, ds2, key)
+            ds2 = clear(ds2, np.int32(0))
+            jax.block_until_ready(toks)
+            if i + 1 < len(self._rungs):
+                ds = grow(ds2)
+        # priming wave: the live path end-to-end, worker thread included
+        self.start()
+        prime = []
+        with self._work:
+            if not self._draining:
+                for _ in range(self.max_sessions):
+                    sess = DecodeSession([0], max_tokens=2, temperature=1.0,
+                                         top_k=2, eos_id=None, engine=self)
+                    sess._synthetic = True
+                    self._sessions[sess.id] = sess
+                    self._pending.append(sess)
+                    prime.append(sess)
+                self._work.notify_all()
+        for sess in prime:
+            for _ in sess.events(token_deadline_s=120.0):
+                pass
+        with self._work:
+            self._warmed = True
+
+    def readiness(self) -> Tuple[bool, List[str]]:
+        reasons = []
+        if not self._warmed:
+            reasons.append("decode slot ladder not warmed")
+        if not self._running:
+            reasons.append("decode worker not running")
+        return (not reasons), reasons
+
+    def stop(self, drain: bool = False, drain_timeout_s: float = 10.0):
+        """Stop the worker. ``drain=True`` first refuses new sessions and
+        waits (bounded) for active ones to finish; anything still live at
+        the deadline gets a terminal error event."""
+        with self._work:
+            self._draining = True
+            self._work.notify_all()
+        if drain:
+            deadline = time.monotonic() + drain_timeout_s
+            while time.monotonic() < deadline:
+                with self._work:
+                    if not self._slots and not self._pending:
+                        break
+                time.sleep(0.01)
+        with self._work:
+            self._running = False
+            self._work.notify_all()
+            leftovers = list(self._slots.values()) + list(self._pending)
+            self._slots.clear()
+            self._pending.clear()
+        for sess in leftovers:
+            if not sess._finished:
+                sess._finished = True
+                sess._emit({"type": "error", "error": "engine_stopped",
+                            "message": "decode engine stopped"})
+        self._swap_stop.set()
+        if self._worker is not None:
+            self._worker.join(timeout=5.0)
+        if self._swap_thread is not None:
+            self._swap_thread.join(timeout=5.0)
+        self._m_active.set(0)
+
+    # ------------------------------------------------------------ admission
+    def open_session(self, prompt_ids: Sequence[int], *,
+                     max_tokens: int = 64, temperature: float = 1.0,
+                     top_k: int = 0, eos_id: Optional[int] = None
+                     ) -> DecodeSession:
+        """Admit a generative stream, or refuse: SessionLimitError (429)
+        when every slot the ladder may grow to is held, EngineStoppedError
+        (503) when stopping/draining. Admission itself happens on the
+        decode worker at the next token boundary."""
+        prompt_ids = list(prompt_ids)
+        if not prompt_ids:
+            raise ValueError("empty prompt: decode needs >= 1 prompt token")
+        bad = [i for i in prompt_ids
+               if not (0 <= int(i) < self.vocab_size)]
+        if bad:
+            raise ValueError(
+                f"prompt ids out of range [0, {self.vocab_size}): {bad[:5]}")
+        if max_tokens < 1:
+            raise ValueError("max_tokens must be >= 1")
+        with self._work:
+            if not self._running or self._draining:
+                raise EngineStoppedError("decode engine is not accepting "
+                                         "sessions (stopped or draining)")
+            if len(self._sessions) >= self.max_sessions:
+                raise SessionLimitError(
+                    f"all {self.max_sessions} decode sessions in use")
+            sess = DecodeSession(prompt_ids, max_tokens=max_tokens,
+                                 temperature=temperature, top_k=top_k,
+                                 eos_id=eos_id, engine=self)
+            self._sessions[sess.id] = sess
+            self._pending.append(sess)
+            self._work.notify_all()
+        return sess
+
+    def _nudge(self):
+        with self._work:
+            self._work.notify_all()
+
+    # ----------------------------------------------------------- the worker
+    def _run_loop(self):
+        """The decode loop: admit → step → deliver, forever. Every device
+        state mutation (join/clear/grow/swap) happens here, between step
+        dispatches — the token-boundary contract."""
+        step = self._prog("step")
+        while True:
+            with self._work:
+                if not self._running:
+                    return
+                if self._pending_swap is not None:
+                    self._apply_swap_locked()
+                self._admit_pending_locked()
+                if not self._slots:
+                    self._work.wait(0.05)
+                    continue
+                params, state = self._params, self._state
+                dstate, key = self._dstate, self._key
+                occupied = dict(self._slots)
+            try:
+                dstate, toks_dev, key = step(params, state, dstate, key)
+                toks = _host_read(toks_dev)
+            except Exception as e:  # pragma: no cover - device failure
+                log.exception("decode step failed; terminating sessions")
+                self._fail_all(e)
+                return
+            with self._work:
+                self._dstate, self._key = dstate, key
+                self._steps += 1
+                self._m_steps.inc()
+                self._deliver_locked(toks, occupied)
+
+    def _admit_pending_locked(self):
+        join = self._prog("join")
+        grow = self._prog("grow")
+        while self._pending:
+            sess = self._pending[0]
+            if sess._cancelled:
+                self._pending.popleft()
+                self._sessions.pop(sess.id, None)
+                if not sess._finished:
+                    sess._finish("cancelled")
+                continue
+            if not self._free:
+                if self._cap_idx + 1 >= len(self._rungs):
+                    break  # ladder maxed; admission gate should prevent this
+                old = self.capacity
+                self._dstate = grow(self._dstate)
+                self._cap_idx += 1
+                self._free.extend(range(old, self.capacity))
+            self._pending.popleft()
+            slot = self._free.pop()
+            carry, first_tok, self._key = self._run_prefill(
+                sess.prompt_ids, sess.temperature, sess.top_k, self._key)
+            self._dstate = join(self._dstate, np.int32(slot), carry,
+                                first_tok, np.float32(sess.temperature),
+                                np.int32(sess.top_k))
+            sess.slot = slot
+            self._slots[slot] = sess
+            self._m_active.set(len(self._slots))
+            self._deliver_one_locked(sess, int(_host_read(first_tok)))
+
+    def _run_prefill(self, ids: List[int], temp: float, topk: int, key):
+        """Right-padded bucketed prefill; prompts longer than the top
+        bucket chunk through it with the carry threaded. Returns the
+        batch-1 carry after the full prompt plus the sampled first
+        token (device scalars — no host read here)."""
+        pf = self._prog("prefill")
+        params, state = self._params, self._state
+        carry = self._carry1
+        top = self._buckets[-1]
+        pos = 0
+        tok = None
+        while pos < len(ids):
+            rem = len(ids) - pos
+            if rem > top:
+                n, bucket = top, top
+            else:
+                n = rem
+                bucket = next(b for b in self._buckets if b >= rem)
+            chunk = np.zeros((1, bucket), dtype=np.int32)
+            chunk[0, :n] = ids[pos:pos + n]
+            carry, tok, key = pf(params, state, jnp.asarray(chunk),
+                                 np.int32(n), carry, np.float32(temp),
+                                 np.int32(topk), key)
+            pos += n
+        return carry, tok, key
+
+    def _deliver_locked(self, toks: np.ndarray, occupied: Dict[int, "DecodeSession"]):
+        for slot, sess in occupied.items():
+            if sess._cancelled:
+                self._retire_locked(sess, "cancelled")
+                continue
+            self._deliver_one_locked(sess, int(toks[slot]))
+
+    def _deliver_one_locked(self, sess: DecodeSession, tok: int):
+        now = time.monotonic()
+        sess.generated.append(tok)
+        if sess.first_token_at is None:
+            sess.first_token_at = now
+            if not sess._synthetic:
+                self._m_ttft.observe((now - sess.opened_at) * 1e3)
+        elif sess._last_token_at is not None and not sess._synthetic:
+            self._m_itl.observe((now - sess._last_token_at) * 1e3)
+        sess._last_token_at = now
+        if not sess._synthetic:
+            self._m_tokens.inc()
+        text = None
+        if self.vocab is not None and 0 <= tok < len(self.vocab):
+            text = self.vocab[tok]
+        sess._emit({"type": "token", "id": tok,
+                    "index": len(sess.generated) - 1, "text": text})
+        if sess.eos_id is not None and tok == sess.eos_id:
+            self._retire_locked(sess, "eos")
+        elif len(sess.generated) >= sess.max_tokens:
+            self._retire_locked(sess, "max_tokens")
+
+    def _retire_locked(self, sess: DecodeSession, reason: str):
+        slot = sess.slot
+        if slot is not None and self._slots.get(slot) is sess:
+            self._dstate = self._prog("clear")(self._dstate, np.int32(slot))
+            del self._slots[slot]
+            self._free.append(slot)
+        sess.slot = None
+        self._sessions.pop(sess.id, None)
+        self._m_active.set(len(self._slots))
+        if not sess._finished:
+            sess._finish(reason)
+        self._work.notify_all()
+
+    def _fail_all(self, err: Exception):
+        with self._work:
+            self._running = False
+            sessions = list(self._sessions.values())
+            self._sessions.clear()
+            self._slots.clear()
+            self._pending.clear()
+        for sess in sessions:
+            sess._finished = True
+            sess._emit({"type": "error", "error": "engine_failure",
+                        "message": str(err)})
+
+    # ------------------------------------------------------------- hot swap
+    def start_hot_swap(self, checkpoint_manager, poll_secs: float = 5.0,
+                       policy: str = "carry"):
+        """Poll for newer checkpoints and apply them at a token boundary.
+        ``policy="carry"``: sessions keep their device carries across the
+        param swap. ``policy="reprefill"``: each live session's carry is
+        rebuilt under the new params from prompt + generated history."""
+        if policy not in ("carry", "reprefill"):
+            raise ValueError(f"unknown hot-swap policy {policy!r}")
+        self._swap_cm = checkpoint_manager
+        self._swap_policy = policy
+        self._swap_seen_step = checkpoint_manager.latest_step()
+        self._swap_thread = threading.Thread(
+            target=self._swap_loop, args=(poll_secs,),
+            name="decode-hot-swap", daemon=True)
+        self._swap_thread.start()
+
+    def _swap_loop(self, poll_secs: float):
+        errors = 0
+        while not self._swap_stop.wait(poll_secs * (1 + min(errors, 5))):
+            try:
+                self.poll_checkpoint()
+                errors = 0
+            except Exception:
+                errors += 1
+                log.exception("decode hot-swap poll failed (%d)", errors)
+
+    def poll_checkpoint(self) -> bool:
+        """One poll: restore a strictly newer checkpoint off-path, check
+        the param structure matches, then hand it to the decode worker to
+        swap between dispatches. Returns True when a swap was staged."""
+        cm = self._swap_cm
+        if cm is None:
+            return False
+        cm.refresh()
+        refresh_err = getattr(cm, "last_refresh_error", None)
+        if refresh_err is not None:
+            # the journal re-read failed: this probe learned nothing —
+            # surface the fault so the poll loop backs off
+            raise refresh_err
+        latest = cm.latest_step()
+        if latest is None or (self._swap_seen_step is not None
+                              and latest <= self._swap_seen_step):
+            return False
+        net = cm.restore_latest(load_updater=False)
+        if net is None:
+            return False
+        # restore_latest may fall back past a torn newest entry to a
+        # checkpoint at-or-before the one being served — don't downgrade
+        restored_step = getattr(getattr(net, "_restored_from", None),
+                                "step", latest)
+        if self._swap_seen_step is not None \
+                and restored_step <= self._swap_seen_step:
+            return False
+        old_td = jax.tree_util.tree_structure(self._params)
+        new_td = jax.tree_util.tree_structure(net.params)
+        if old_td != new_td:
+            log.warning("hot-swap refused: checkpoint param structure "
+                        "changed (%s != %s)", new_td, old_td)
+            self._swap_seen_step = latest
+            return False
+        with self._work:
+            self._pending_swap = (net.params, net.state, latest)
+            self._swap_seen_step = latest
+            self._work.notify_all()
+        return True
+
+    def _apply_swap_locked(self):
+        params, state, ckpt_step = self._pending_swap
+        self._pending_swap = None
+        self._params, self._state = params, state
+        self._swap_count += 1
+        self._m_swaps.inc()
+        log.info("decode hot-swap applied at step boundary (checkpoint "
+                 "step %s, policy=%s, %d live sessions)", ckpt_step,
+                 self._swap_policy, len(self._slots))
+        if self._swap_policy != "reprefill":
+            return
+        join = self._prog("join")
+        for slot, sess in list(self._slots.items()):
+            history = sess.prompt_ids + sess.generated[:-1]
+            last = sess.generated[-1] if sess.generated else None
+            if last is None:  # not yet delivered anything: plain re-admit
+                history, last = sess.prompt_ids, 0
+            carry, _, self._key = self._run_prefill(
+                history, sess.temperature, sess.top_k, self._key)
+            self._dstate = join(self._dstate, np.int32(slot), carry,
+                                jnp.asarray(last, dtype=jnp.int32),
+                                np.float32(sess.temperature),
+                                np.int32(sess.top_k))
+
+    # ---------------------------------------------------------------- stats
+    @property
+    def active_sessions(self) -> int:
+        with self._lock:
+            return len(self._slots)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "active": len(self._slots),
+                "pending": len(self._pending),
+                "capacity": self.capacity,
+                "max_sessions": self.max_sessions,
+                "steps": self._steps,
+                "hot_swaps": self._swap_count,
+                "warmed": self._warmed,
+                "compiles": {k: self._watch.compiles(f"decode.{k}")
+                             for k in ("step", "join", "clear", "grow",
+                                       "prefill")},
+            }

@@ -46,6 +46,18 @@ Routes::
     GET  /v1/models/<name>            one model's stats (pi + breaker)
     POST /v1/models/<name>:predict    {"inputs": [[...], ...],
                                        "deadline_ms": 250}  (optional)
+    POST /v1/models/<name>:generate   {"prompt_ids": [...], "max_tokens":
+                                       64, "stream": true}  (serving/decode)
+
+Generate streams tokens as Server-Sent Events over chunked HTTP/1.1
+(``event: token`` / ``done`` / ``error`` frames); ``stream: false``
+collects the whole generation into one JSON response. Admission rides
+the same taxonomy as predict — 429 when every decode session slot is
+held, 503 draining/stopped, and 504 when the FIRST token misses
+``deadline_ms`` (time-to-first-token). After streaming starts the
+status is already 200, so a later token missing ``token_deadline_ms``
+terminates the stream with a typed in-band ``error`` event instead —
+a stream never silently stalls.
 
 Predict bodies carry the tensor either as a JSON float list (``inputs``)
 or as the BINARY wire format — base64-encoded little-endian raw array
@@ -75,7 +87,7 @@ import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional
+from typing import Dict, Optional, Sequence
 from urllib.parse import urlparse
 
 import numpy as np
@@ -84,13 +96,16 @@ from deeplearning4j_tpu.parallel.inference import (DeadlineExpiredError,
                                                    ParallelInference,
                                                    QueueFullError)
 from deeplearning4j_tpu.serving.breaker import CircuitBreaker
+from deeplearning4j_tpu.serving.decode import (DecodeEngine,
+                                               EngineStoppedError,
+                                               SessionLimitError)
 from deeplearning4j_tpu.serving.wire import decode_array, encode_array
 from deeplearning4j_tpu.utils.http import parse_content_length
 
 log = logging.getLogger(__name__)
 
-__all__ = ["ModelEndpoint", "ModelServer", "BreakerOpenError",
-           "ModelDispatchError"]
+__all__ = ["ModelEndpoint", "GenerateEndpoint", "ModelServer",
+           "BreakerOpenError", "ModelDispatchError"]
 
 
 class BreakerOpenError(RuntimeError):
@@ -212,6 +227,63 @@ class ModelEndpoint:
         }
 
 
+class GenerateEndpoint:
+    """One generative model behind ``POST /v1/models/<name>:generate``:
+    a :class:`~deeplearning4j_tpu.serving.decode.DecodeEngine` plus the
+    HTTP-facing policy — token-budget cap, time-to-first-token and
+    per-token deadline defaults, and the optional vocab that lets
+    clients send ``"prompt"`` strings instead of ``"prompt_ids"``.
+    Build through :meth:`ModelServer.add_generator`."""
+
+    def __init__(self, name: str, engine: DecodeEngine, *,
+                 default_max_tokens: int = 64,
+                 max_max_tokens: int = 1024,
+                 default_deadline_ms: float = 1000.0,
+                 default_token_deadline_ms: float = 10000.0):
+        self.name = name
+        self.engine = engine
+        self.default_max_tokens = int(default_max_tokens)
+        self.max_max_tokens = int(max_max_tokens)
+        self.default_deadline_ms = float(default_deadline_ms)
+        self.default_token_deadline_ms = float(default_token_deadline_ms)
+        self._stoi = (None if engine.vocab is None
+                      else {c: i for i, c in enumerate(engine.vocab)})
+
+    @property
+    def warmed(self) -> bool:
+        return self.engine.readiness()[0]
+
+    def warmup(self):
+        """Compile the decode slot ladder + prefill buckets and run the
+        priming wave; flips this generator's readiness gate."""
+        self.engine.warmup()
+        return self
+
+    def encode_prompt(self, prompt: str):
+        if self._stoi is None:
+            raise ValueError(
+                f"generator '{self.name}' has no vocab — send "
+                "'prompt_ids' (a list of token ids) instead of 'prompt'")
+        try:
+            return [self._stoi[c] for c in prompt]
+        except KeyError as e:
+            raise ValueError(f"prompt character {e} is not in generator "
+                             f"'{self.name}'s vocab") from e
+
+    def stats(self) -> dict:
+        return {
+            **self.engine.stats(),
+            "default_max_tokens": self.default_max_tokens,
+            "max_max_tokens": self.max_max_tokens,
+            "default_deadline_ms": self.default_deadline_ms,
+            "default_token_deadline_ms": self.default_token_deadline_ms,
+            "has_vocab": self._stoi is not None,
+        }
+
+    def shutdown(self, drain: bool = False, drain_timeout_s: float = 10.0):
+        self.engine.stop(drain=drain, drain_timeout_s=drain_timeout_s)
+
+
 def _decode_inputs(body: dict, ep: "ModelEndpoint") -> np.ndarray:
     """Predict-body tensor decode: JSON ``inputs`` float lists, or the
     binary wire format ``{"x_b64", "dtype", "shape"}`` (serving/wire.py —
@@ -232,6 +304,10 @@ def _decode_inputs(body: dict, ep: "ModelEndpoint") -> np.ndarray:
 
 class _Handler(BaseHTTPRequestHandler):
     server_ref: Optional["ModelServer"] = None
+    # HTTP/1.1 so :generate can stream with chunked transfer encoding;
+    # every non-stream response still carries Content-Length, so plain
+    # keep-alive request/response traffic is unaffected
+    protocol_version = "HTTP/1.1"
     # slow-client guard: a peer that stops sending mid-request times out
     # and frees its handler thread instead of holding it forever
     timeout = 30.0
@@ -247,6 +323,12 @@ class _Handler(BaseHTTPRequestHandler):
         if retry_after_s is not None:
             self.send_header("Retry-After",
                              str(max(1, math.ceil(retry_after_s))))
+        if code >= 400:
+            # error paths may answer before consuming the request body
+            # (404/413/...), which under keep-alive would poison the next
+            # request on the reused connection — close it instead
+            self.send_header("Connection", "close")
+            self.close_connection = True
         self.end_headers()
         try:
             self.wfile.write(body)
@@ -270,7 +352,8 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/healthz":
             self._json({"ok": True, "draining": srv.draining,
                         "models": sorted(srv.endpoints),
-                        "indexes": sorted(srv.indexes)})
+                        "indexes": sorted(srv.indexes),
+                        "generators": sorted(srv.generators)})
         elif path == "/readyz":
             ready, reasons = srv.readiness()
             if ready:
@@ -283,17 +366,22 @@ class _Handler(BaseHTTPRequestHandler):
                        "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/v1/models":
             self._json({"models": {n: ep.stats()
-                                   for n, ep in srv.endpoints.items()}})
+                                   for n, ep in srv.endpoints.items()},
+                        "generators": {n: g.stats()
+                                       for n, g in srv.generators.items()}})
         elif path == "/v1/indexes":
             self._json({"indexes": {n: ep.stats()
                                     for n, ep in srv.indexes.items()}})
         elif path.startswith("/v1/models/"):
             name = path[len("/v1/models/"):]
             ep = srv.endpoints.get(name)
-            if ep is None:
-                self._error(404, "unknown_model", f"no model '{name}'")
-            else:
+            if ep is not None:
                 self._json({"model": name, **ep.stats()})
+            elif name in srv.generators:
+                self._json({"model": name,
+                            **srv.generators[name].stats()})
+            else:
+                self._error(404, "unknown_model", f"no model '{name}'")
         elif path.startswith("/v1/indexes/"):
             name = path[len("/v1/indexes/"):]
             ep = srv.indexes.get(name)
@@ -310,6 +398,8 @@ class _Handler(BaseHTTPRequestHandler):
         path = urlparse(self.path).path
         if path.startswith("/v1/models/") and path.endswith(":predict"):
             self._do_predict(srv, path)
+        elif path.startswith("/v1/models/") and path.endswith(":generate"):
+            self._do_generate(srv, path)
         elif path.startswith("/v1/indexes/") and path.endswith(":query"):
             self._do_query(srv, path)
         else:
@@ -398,6 +488,190 @@ class _Handler(BaseHTTPRequestHandler):
             })
         finally:
             srv._exit_request()
+
+    def _do_generate(self, srv, path):
+        """``POST /v1/models/<name>:generate`` — admit a generative
+        session on the model's DecodeEngine and deliver its tokens,
+        either streamed as SSE over chunked HTTP or collected into one
+        JSON body. The 429/503/504 taxonomy applies up to the first
+        token; afterwards deadline faults become typed in-band events."""
+        name = path[len("/v1/models/"):-len(":generate")]
+        gep = srv.generators.get(name)
+        if gep is None:
+            self._error(404, "unknown_model", f"no generator '{name}'")
+            return
+        length, err = parse_content_length(self.headers, srv.max_body_bytes)
+        if err is not None:
+            code, message = err
+            self._error(code, "bad_request" if code == 400
+                        else "body_too_large", message)
+            return
+        srv._m_requests.inc()
+        if not srv._enter_request():
+            srv._m_drain_rejected.inc()
+            self._error(503, "draining",
+                        "server is draining; retry against another replica",
+                        retry_after_s=srv.retry_after_s)
+            return
+        t0 = time.perf_counter()
+        sess = None
+        try:
+            try:
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+                if "prompt_ids" in body:
+                    prompt_ids = [int(i) for i in body["prompt_ids"]]
+                elif "prompt" in body:
+                    prompt_ids = gep.encode_prompt(str(body["prompt"]))
+                else:
+                    raise ValueError(
+                        "body needs 'prompt_ids' (a list of token ids) "
+                        "or 'prompt' (a string, on generators with a "
+                        "vocab)")
+                max_tokens = int(body.get("max_tokens",
+                                          gep.default_max_tokens))
+                if not 1 <= max_tokens <= gep.max_max_tokens:
+                    raise ValueError(f"max_tokens must be in "
+                                     f"[1, {gep.max_max_tokens}]; "
+                                     f"got {max_tokens}")
+                temperature = float(body.get("temperature", 1.0))
+                if not (temperature >= 0.0):  # also rejects NaN
+                    raise ValueError(
+                        f"temperature must be >= 0; got {temperature}")
+                top_k = int(body.get("top_k", 0))
+                eos_id = body.get("eos_id")
+                stream = bool(body.get("stream", True))
+                deadline_ms = body.get(
+                    "deadline_ms", self.headers.get("X-Deadline-Ms"))
+                deadline_ms = (gep.default_deadline_ms if deadline_ms
+                               is None else float(deadline_ms))
+                token_deadline_ms = float(body.get(
+                    "token_deadline_ms", gep.default_token_deadline_ms))
+            except (ValueError, TypeError, KeyError) as e:
+                self._error(400, "bad_request", f"malformed request: {e}")
+                return
+            try:
+                sess = gep.engine.open_session(
+                    prompt_ids, max_tokens=max_tokens,
+                    temperature=temperature, top_k=top_k,
+                    eos_id=None if eos_id is None else int(eos_id))
+            except SessionLimitError as e:
+                srv._m_shed.inc()
+                self._error(429, "shed", str(e),
+                            retry_after_s=srv.retry_after_s)
+                return
+            except EngineStoppedError as e:
+                srv._m_drain_rejected.inc()
+                self._error(503, "draining", str(e),
+                            retry_after_s=srv.retry_after_s)
+                return
+            except ValueError as e:
+                self._error(400, "bad_request", f"malformed request: {e}")
+                return
+            # time-to-first-token deadline: nothing has been written yet,
+            # so a miss still gets a proper 504 status line
+            first = sess.next_event(
+                timeout_s=deadline_ms / 1000.0 if deadline_ms > 0 else None)
+            if first is None:
+                sess.cancel()
+                srv._m_expired.inc()
+                srv._m_request_ms.observe((time.perf_counter() - t0) * 1e3)
+                self._error(504, "deadline_expired",
+                            f"no first token within {deadline_ms:.0f}ms")
+                return
+            if first["type"] == "error":
+                srv._m_errors.inc()
+                self._error(503 if first.get("error") == "engine_stopped"
+                            else 500, first.get("error", "decode_failed"),
+                            first.get("message", "decode failed"),
+                            retry_after_s=srv.retry_after_s)
+                return
+            token_deadline_s = (token_deadline_ms / 1000.0
+                                if token_deadline_ms > 0 else None)
+            if stream:
+                self._stream_generate(srv, name, sess, first,
+                                      token_deadline_s, t0)
+            else:
+                self._collect_generate(srv, name, gep, sess, first,
+                                       token_deadline_s, t0)
+        finally:
+            if sess is not None and not sess.finished:
+                sess.cancel()  # free the slot at the next token boundary
+            srv._exit_request()
+
+    def _stream_generate(self, srv, name, sess, first, token_deadline_s,
+                         t0):
+        """SSE over chunked HTTP/1.1: one ``event:``/``data:`` frame per
+        engine event, each its own chunk so tokens flush as they land.
+        The terminal frame is always ``done`` or a typed ``error``."""
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("Connection", "close")
+        self.close_connection = True
+        self.end_headers()
+
+        def send_event(ev: dict):
+            payload = json.dumps({k: v for k, v in ev.items()
+                                  if k != "type"})
+            data = f"event: {ev['type']}\ndata: {payload}\n\n".encode()
+            self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+            self.wfile.flush()
+
+        terminal = None
+        try:
+            send_event({"type": "meta", "model": name,
+                        "session": sess.id})
+            send_event(first)
+            if first["type"] in ("done", "error"):
+                terminal = first
+            else:
+                for ev in sess.events(token_deadline_s):
+                    send_event(ev)
+                    if ev["type"] in ("done", "error"):
+                        terminal = ev
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            sess.cancel()  # client went away mid-stream: free the slot
+            return
+        if terminal is not None and terminal["type"] == "error":
+            if terminal.get("error") == "token_deadline_expired":
+                srv._m_expired.inc()
+            else:
+                srv._m_errors.inc()
+        srv._m_request_ms.observe((time.perf_counter() - t0) * 1e3)
+
+    def _collect_generate(self, srv, name, gep, sess, first,
+                          token_deadline_s, t0):
+        """``stream: false`` — drain the whole generation, answer once."""
+        events = [first]
+        if first["type"] not in ("done", "error"):
+            events.extend(sess.events(token_deadline_s))
+        terminal = events[-1]
+        if terminal["type"] == "error":
+            if terminal.get("error") == "token_deadline_expired":
+                srv._m_expired.inc()
+                self._error(504, "deadline_expired",
+                            terminal.get("message", "token deadline"))
+            else:
+                srv._m_errors.inc()
+                self._error(503 if terminal.get("error") == "engine_stopped"
+                            else 500,
+                            terminal.get("error", "decode_failed"),
+                            terminal.get("message", "decode failed"),
+                            retry_after_s=srv.retry_after_s)
+            return
+        toks = [ev for ev in events if ev["type"] == "token"]
+        srv._m_request_ms.observe((time.perf_counter() - t0) * 1e3)
+        out = {"model": name, "session": sess.id,
+               "token_ids": [ev["id"] for ev in toks],
+               "tokens": len(toks), "reason": terminal.get("reason")}
+        if gep.engine.vocab is not None:
+            out["text"] = "".join(ev.get("text") or "" for ev in toks)
+        self._json(out)
 
     def _do_query(self, srv, path):
         """``POST /v1/indexes/<name>:query`` — batched vector k-NN with
@@ -548,9 +822,15 @@ class ModelServer:
                  max_body_bytes: int = 8 << 20,
                  default_deadline_ms: float = 1000.0,
                  retry_after_s: float = 1.0,
-                 queue_depth: int = 256, batch_limit: int = 32):
+                 queue_depth: int = 256, batch_limit: int = 32,
+                 compile_cache_dir: Optional[str] = None):
         # loopback by default, like the UI/kNN servers: exposing an
         # unauthenticated predict endpoint beyond the host is an opt-in
+        if compile_cache_dir is not None:
+            from deeplearning4j_tpu.perf.compile_cache import \
+                enable_compilation_cache
+            enable_compilation_cache(compile_cache_dir)
+        self.compile_cache_dir = compile_cache_dir
         self.port = port
         self.bind_address = bind_address
         self.max_body_bytes = int(max_body_bytes)
@@ -560,6 +840,7 @@ class ModelServer:
         self._default_batch_limit = int(batch_limit)
         self.endpoints: Dict[str, ModelEndpoint] = {}
         self.indexes: Dict[str, object] = {}  # name -> IndexEndpoint
+        self.generators: Dict[str, GenerateEndpoint] = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._warmup_thread: Optional[threading.Thread] = None
@@ -666,6 +947,52 @@ class ModelServer:
         self.endpoints[name] = ep
         return ep
 
+    def add_generator(self, name: str, model, *,
+                      max_sessions: int = 64, min_slots: int = 8,
+                      prefill_buckets: Sequence[int] = (16, 64, 256),
+                      vocab: Optional[Sequence[str]] = None, seed: int = 0,
+                      default_max_tokens: int = 64,
+                      max_max_tokens: int = 1024,
+                      default_deadline_ms: Optional[float] = None,
+                      default_token_deadline_ms: float = 10000.0,
+                      checkpoint_manager=None,
+                      checkpoint_poll_secs: Optional[float] = None,
+                      hot_swap_policy: str = "carry") -> GenerateEndpoint:
+        """Register a generative (autoregressive) model behind
+        ``POST /v1/models/<name>:generate``. Builds a
+        :class:`~deeplearning4j_tpu.serving.decode.DecodeEngine` (pass
+        one directly to control the slot ladder yourself) and starts its
+        decode worker; the slot-ladder warmup rides the server's warmup
+        pass and gates ``/readyz``. ``checkpoint_manager`` enables
+        mid-generation hot-swap (``hot_swap_policy`` "carry" keeps
+        session carries across the param swap, "reprefill" rebuilds them
+        from prompt + generated history under the new params)."""
+        if name in self.generators:
+            raise ValueError(f"generator '{name}' already registered")
+        if isinstance(model, DecodeEngine):
+            engine = model
+        else:
+            engine = DecodeEngine(model, max_sessions=max_sessions,
+                                  min_slots=min_slots,
+                                  prefill_buckets=prefill_buckets,
+                                  seed=seed, vocab=vocab)
+        engine.start()
+        if checkpoint_manager is not None:
+            engine.start_hot_swap(
+                checkpoint_manager,
+                poll_secs=(5.0 if checkpoint_poll_secs is None
+                           else checkpoint_poll_secs),
+                policy=hot_swap_policy)
+        gep = GenerateEndpoint(
+            name, engine, default_max_tokens=default_max_tokens,
+            max_max_tokens=max_max_tokens,
+            default_deadline_ms=(self.default_deadline_ms
+                                 if default_deadline_ms is None
+                                 else default_deadline_ms),
+            default_token_deadline_ms=default_token_deadline_ms)
+        self.generators[name] = gep
+        return gep
+
     def add_index(self, name: str, index, *, k_default: int = 10,
                   k_max: int = 128,
                   default_deadline_ms: Optional[float] = None,
@@ -735,8 +1062,11 @@ class ModelServer:
 
     def warmup(self):
         """Compile every endpoint's warmup ladder (gates ``/readyz``) —
-        model bucket ladders and index (bucket × k-rung) ladders alike."""
-        for ep in list(self.endpoints.values()) + list(self.indexes.values()):
+        model bucket ladders, index (bucket × k-rung) ladders and decode
+        slot ladders alike."""
+        for ep in (list(self.endpoints.values())
+                   + list(self.indexes.values())
+                   + list(self.generators.values())):
             try:
                 ep.warmup()
             except Exception:
@@ -749,11 +1079,15 @@ class ModelServer:
                           if not ep.warmed)
         unwarmed_ix = sorted(n for n, ep in self.indexes.items()
                              if not ep.warmed)
+        unwarmed_gen = sorted(n for n, g in self.generators.items()
+                              if not g.warmed)
         reasons = []
         if unwarmed:
             reasons.append(f"warmup pending: {unwarmed}")
         if unwarmed_ix:
             reasons.append(f"index warmup pending: {unwarmed_ix}")
+        if unwarmed_gen:
+            reasons.append(f"decode warmup pending: {unwarmed_gen}")
         if self.draining:
             reasons.append("draining")
         return (not reasons, reasons)
@@ -815,6 +1149,11 @@ class ModelServer:
                 ep.pi.shutdown()
         for iep in self.indexes.values():
             iep.shutdown()
+        for gep in self.generators.values():
+            # server-level drain above already waited out live streams;
+            # this stops the decode worker (bounded) and error-terminates
+            # anything still stuck
+            gep.shutdown(drain=drain, drain_timeout_s=drain_timeout_s)
 
     @property
     def address(self) -> str:

@@ -41,9 +41,19 @@ def _parse_model(spec: str):
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--model", action="append", type=_parse_model,
-                   required=True, metavar="NAME=CKPT_DIR",
+                   default=[], metavar="NAME=CKPT_DIR",
                    help="model name + checkpoint directory (repeatable: "
                         "several nets behind one server)")
+    p.add_argument("--generate", action="append", type=_parse_model,
+                   default=[], metavar="NAME=CKPT_DIR",
+                   help="generative (stateful RNN) model to serve behind "
+                        "POST /v1/models/NAME:generate with token "
+                        "streaming (repeatable); hot-swaps with "
+                        "--poll-secs like --model")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persist XLA executables under DIR "
+                        "(perf.compile_cache): the second cold start "
+                        "replays warmup compiles from disk")
     p.add_argument("--port", type=int, default=9100)
     p.add_argument("--bind", default="127.0.0.1",
                    help="bind address (default loopback; 0.0.0.0 to "
@@ -70,13 +80,18 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if not args.model and not args.generate:
+        print("error: need at least one --model or --generate",
+              file=sys.stderr)
+        return 2
     from deeplearning4j_tpu.checkpoint import CheckpointManager
     from deeplearning4j_tpu.serving import ModelServer
 
     server = ModelServer(port=args.port, bind_address=args.bind,
                          default_deadline_ms=args.deadline_ms,
                          queue_depth=args.queue_depth,
-                         batch_limit=args.batch_limit)
+                         batch_limit=args.batch_limit,
+                         compile_cache_dir=args.compile_cache)
     managers = []
     for name, ckpt_dir in args.model:
         cm = CheckpointManager(ckpt_dir)
@@ -112,8 +127,31 @@ def main(argv=None) -> int:
               + (" (int8-quantized)" if record is not None else ""),
               flush=True)
 
-    server.start(warmup=False)  # no example shape on file: first-request
-    print(f"serving {len(server.endpoints)} model(s) on "
+    for name, ckpt_dir in args.generate:
+        cm = CheckpointManager(ckpt_dir)
+        managers.append(cm)
+        net = cm.restore_latest(load_updater=False)
+        if net is None:
+            print(f"error: no restorable checkpoint in {ckpt_dir!r} "
+                  f"for generator '{name}'", file=sys.stderr)
+            return 2
+        try:
+            server.add_generator(
+                name, net,
+                checkpoint_manager=cm if args.poll_secs else None,
+                checkpoint_poll_secs=args.poll_secs)
+        except (ValueError, TypeError) as e:
+            print(f"error: cannot serve generator '{name}': {e}",
+                  file=sys.stderr)
+            return 2
+        print(f"generator '{name}': serving checkpoint step "
+              f"{net._restored_from.step} from {ckpt_dir}", flush=True)
+
+    # predict endpoints have no example shape on file (first-request
+    # compiles); decode slot ladders DO warm — async, gating /readyz
+    server.start(warmup=bool(server.generators))
+    print(f"serving {len(server.endpoints)} model(s) + "
+          f"{len(server.generators)} generator(s) on "
           f"{server.address} (hot-swap "
           f"{'every %gs' % args.poll_secs if args.poll_secs else 'off'})",
           flush=True)
