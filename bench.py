@@ -72,6 +72,11 @@ NOMINAL = {
                                   # lease claim budget
     "data_plane_wait": 10.0,    # %, nominal data-wait share of a fit
                                 # epoch before prefetch tuning
+    "data_lake": 1_000_000.0,   # records/sec, same host-ETL nominal as
+                                # data_plane — the lake arms show what
+                                # the wire + cache tiers cost vs it
+    "data_lake_restore": 100.0,  # ms, nominal small-model restore budget
+                                 # (the resilience figure, now per tier)
     "retrieval": 10_000.0,      # queries/sec, nominal GPU brute-force
                                 # ANN server at ~100k vectors
     "autotune": 1.0,            # x, tuned-vs-default step-time ratio
@@ -1639,6 +1644,121 @@ def bench_data_plane():
               "only — thresholds on quiet full runs per the 9p note.")
 
 
+def bench_data_lake():
+    """Data lake tier costs (9p note: the emulator is loopback HTTP, so
+    the numbers isolate protocol + (de)serialization + cache overhead
+    from real WAN latency): (1) host ETL records/s — in-RAM sharded
+    reader vs lazily-pulled shard files over the wire client, cold and
+    through the warmed disk cache (+ its hit rate); (2) small-model
+    ``restore_latest`` latency per storage tier — local FS, the cloud
+    client over the emulator, and the disk-cached cloud stack on its
+    second (warm) restore."""
+    import shutil
+    import tempfile
+
+    from deeplearning4j_tpu.checkpoint import (CheckpointManager,
+                                               LocalFSBackend,
+                                               RetryingBackend)
+    from deeplearning4j_tpu.checkpoint.cache import CachedBackend
+    from deeplearning4j_tpu.checkpoint.cloud import CloudObjectBackend
+    from deeplearning4j_tpu.checkpoint.emulator import ObjectStoreEmulator
+    from deeplearning4j_tpu.datasets.records import (ShardFileSource,
+                                                     write_shards)
+    from deeplearning4j_tpu.datasets.sharded import ShardedDataset
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+
+    rng = np.random.default_rng(31)
+    n = 4096 if QUICK else 65536
+    batch, per_shard = 256, 512
+    x = rng.standard_normal((n, 64)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, n)]
+
+    def drain(sds):
+        # pure host ETL + storage wire, no device work to sync
+        t0 = time.perf_counter()  # lint: disable=DLT003
+        count = 0
+        for ds in sds.reader():
+            count += ds.num_examples()
+        return count / (time.perf_counter() - t0)
+
+    ram_rps = max(drain(ShardedDataset(x, y, batch_size=batch, seed=5))
+                  for _ in range(REPS))
+    tmp = tempfile.mkdtemp(prefix="bench-lake-")
+    with ObjectStoreEmulator(access_key="bench",
+                             secret_key="bench-secret") as emu:
+        client = RetryingBackend(
+            CloudObjectBackend(emu.url, "lake", access_key="bench",
+                               secret_key="bench-secret"),
+            base_backoff_s=0.01, max_backoff_s=0.2)
+        write_shards(client, "shards/", x, y, records_per_shard=per_shard)
+
+        def lake_sds(store):
+            return ShardedDataset(source=ShardFileSource(store, "shards/"),
+                                  batch_size=batch, seed=5,
+                                  max_resident_shards=4)
+        cold_rps = max(drain(lake_sds(client)) for _ in range(REPS))
+        cache = CachedBackend(client, os.path.join(tmp, "cache"),
+                              max_bytes=1 << 30)
+        drain(lake_sds(cache))          # fill pass
+        cached_rps = max(drain(lake_sds(cache)) for _ in range(REPS))
+        emit("data_lake_records_per_sec", cached_rps, "records/sec",
+             "data_lake", ram_rps=round(ram_rps, 1),
+             lake_cold_rps=round(cold_rps, 1),
+             lake_cached_rps=round(cached_rps, 1),
+             cache_hit_rate=round(cache.stats()["hit_rate"], 3),
+             batch=batch, records=n, records_per_shard=per_shard,
+             note="sharded-reader drain: in-RAM arrays vs shard files "
+                  "pulled through the wire client (cold) vs the warmed "
+                  "disk cache; loopback emulator per the 9p note. "
+                  + _REPS_NOTE)
+
+        # --- restore latency per storage tier -------------------------
+        conf = (NeuralNetConfiguration.builder()
+                .seed(3).updater(Sgd(learning_rate=0.05))
+                .weight_init("xavier").list()
+                .layer(DenseLayer(n_out=32, activation="tanh"))
+                .layer(OutputLayer(n_out=10, loss="mcxent"))
+                .set_input_type(InputType.feed_forward(64))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(ShardedDataset(x[:512], y[:512], batch_size=128,
+                               seed=5).reader(), num_epochs=1)
+
+        def restore_ms(storage):
+            cm = CheckpointManager(storage=storage, async_write=False)
+            cm.save(net)
+
+            def timed():
+                # host-side storage path; restore materializes on host
+                t0 = time.perf_counter()  # lint: disable=DLT003
+                assert cm.restore_latest() is not None
+                return time.perf_counter() - t0
+            best = _best_of(timed) * 1000.0
+            cm.close()
+            return best
+        local_ms = restore_ms(LocalFSBackend(os.path.join(tmp, "ckpt")))
+        emu_ms = restore_ms(RetryingBackend(
+            CloudObjectBackend(emu.url, "ckpt", access_key="bench",
+                               secret_key="bench-secret")))
+        warm = CachedBackend(
+            RetryingBackend(CloudObjectBackend(
+                emu.url, "ckpt-warm", access_key="bench",
+                secret_key="bench-secret")),
+            os.path.join(tmp, "ckpt-cache"), max_bytes=1 << 30)
+        cached_ms = restore_ms(warm)
+        emit("data_lake_restore_ms", cached_ms, "ms", "data_lake_restore",
+             local_fs_ms=round(local_ms, 1),
+             emulator_ms=round(emu_ms, 1),
+             cached_warm_ms=round(cached_ms, 1),
+             note="CheckpointManager.restore_latest of one small model "
+                  "per storage tier; the cached arm is the SECOND "
+                  "restore (disk hits, zero wire reads). " + _REPS_NOTE)
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_retrieval():
     """Vector retrieval: device-batched QPS + recall@10 + index MB for
     the full compression ladder — brute / IVF / int8-IVF / int4 / PQ /
@@ -1873,6 +1993,7 @@ def main():
                ("resilience", bench_resilience),
                ("elastic", bench_elastic),
                ("data_plane", bench_data_plane),
+               ("data_lake", bench_data_lake),
                ("retrieval", bench_retrieval),
                ("pallas", bench_pallas),
                ("grad_compression", bench_grad_compression),

@@ -175,6 +175,18 @@ Rules (waivable per line with ``# lint: disable=DLT00X`` or per file with
   loops (the single bulk read) are fine; waivable inline for a
   deliberately host-side helper.
 
+- **DLT021 unbounded-lake-io**: in the data-lake wire paths
+  (``checkpoint/cloud``, ``checkpoint/emulator``, ``tools/lake``), two
+  hazards the DLT016 scope doesn't cover: (a) a zero-argument
+  ``.read()``/``.recv()``/``.readline()`` on a response/socket/file
+  object — an unbounded read lets one hostile or wedged peer allocate
+  arbitrary host memory (pass an explicit byte bound; validate
+  Content-Length first per utils/http.py); (b) the DLT016 blocking-call
+  table (``HTTP(S)Connection``, ``urlopen``, ``create_connection``,
+  ``requests.*``) without an explicit timeout — the object-store client
+  retries around deadlines, so a block-forever default turns one stalled
+  server into a hung training run. Waivable inline like DLT003.
+
 Interprocedural rule families (DLT017-019) run over the whole-repo call
 graph built by ``analysis/callgraph.py`` — they only fire from
 ``lint_paths`` (and the ``tools/run_lint.py`` CLI), never from
@@ -1612,6 +1624,63 @@ def _repo_rule_thread_lifecycle(graph: "_cg.CallGraph"
     return out
 
 
+# ------------------------------------------------------------------ DLT021
+def _is_lake_io_path(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return any(seg in p for seg in ("checkpoint/cloud", "checkpoint/emulator",
+                                    "tools/lake"))
+
+
+_UNBOUNDED_READ_METHODS = ("read", "recv", "readline")
+
+
+def _rule_unbounded_lake_io(tree, src, path) -> List[LintViolation]:
+    """DLT021: the lake wire paths move attacker-sized bytes between
+    processes, so every read is byte-bounded and every socket call
+    carries a deadline (DLT016's scope extended to checkpoint/cloud,
+    checkpoint/emulator and tools/lake). A zero-argument
+    ``.read()``/``.recv()``/``.readline()`` trusts the peer to stop
+    sending; a timeout-less connection trusts it to keep answering —
+    the retry layer can only bound faults the client surfaces."""
+    if not _is_lake_io_path(path):
+        return []
+    aliases = _import_aliases(tree)
+    out: List[LintViolation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # (a) unbounded reads: method calls with no positional byte bound
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _UNBOUNDED_READ_METHODS
+                and not node.args):
+            out.append(LintViolation(
+                path, node.lineno, "DLT021",
+                f"'.{node.func.attr}()' without a byte bound in a lake "
+                "wire path — an unbounded response/socket read lets one "
+                "hostile or wedged peer allocate arbitrary host memory; "
+                "pass an explicit size (validate Content-Length first, "
+                "utils/http.parse_content_length) or waive inline for a "
+                "provably bounded stream"))
+            continue
+        # (b) DLT016's blocking-call table, same check, lake scope
+        q = _resolve(_dotted(node.func), aliases)
+        if q not in _BLOCKING_IO_CALLS:
+            continue
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            continue
+        slot = _BLOCKING_IO_CALLS[q]
+        if slot is not None and len(node.args) >= slot:
+            continue
+        out.append(LintViolation(
+            path, node.lineno, "DLT021",
+            f"'{q}(...)' without an explicit timeout in a lake wire "
+            "path — the stdlib default blocks forever, so one stalled "
+            "object-store server hangs the training run instead of "
+            "tripping the retry schedule; pass timeout= (or waive "
+            "inline for a deliberately unbounded wait)"))
+    return out
+
+
 # ----------------------------------------------------------------- harness
 _RULES = (
     _rule_module_level_jnp,
@@ -1631,6 +1700,7 @@ _RULES = (
     _rule_host_work_in_pallas_kernel,
     _rule_blocking_io_without_timeout,
     _rule_per_token_host_transfer,
+    _rule_unbounded_lake_io,
 )
 
 
@@ -1688,7 +1758,8 @@ def _lint_file_raw(path: str, src: str) -> List[LintViolation]:
 
 
 def lint_file(path: str, src: Optional[str] = None) -> List[LintViolation]:
-    """Per-file rules (DLT000-016) on one file; waivers applied. The
+    """Per-file rules (DLT000-016, DLT020-021) on one file; waivers
+    applied. The
     interprocedural families (DLT017-019) need the whole-repo graph and
     only run under :func:`lint_paths`."""
     if src is None:

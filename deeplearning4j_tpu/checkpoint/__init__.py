@@ -13,7 +13,16 @@ Five cooperating pieces (see each module's docstring):
 - ``storage``  — the StorageBackend interface: LocalFSBackend (default),
                  ObjectStoreBackend (GCS-style put/get/list/delete) and
                  RetryingBackend (bounded exponential-backoff-with-jitter
-                 retries + per-op timeouts for transient faults);
+                 retries + per-op timeouts for transient faults,
+                 Retry-After hints honored);
+- ``cloud``    — CloudObjectBackend: the real wire-protocol client
+                 (S3-dialect REST, signed requests, paged listing,
+                 multipart puts with abort-on-failure) + backend_from_url;
+- ``cache``    — CachedBackend: local-disk LRU tier (byte-budgeted,
+                 sha256 verify-on-read, single-flight fetches);
+- ``emulator`` — ObjectStoreEmulator: hermetic fault-injecting HTTP
+                 object store for chaos tests (FlakyBackend's successor
+                 at the wire level);
 - ``resume``   — ``train_until``: the auto-resume driver looping
                  restore_latest + fit under a restart budget, turning
                  preemption into a no-op for callers;
@@ -64,6 +73,18 @@ from deeplearning4j_tpu.checkpoint.storage import (  # noqa: F401
     StorageError,
     StorageNotFoundError,
     TransientStorageError,
+    sweep_orphan_keys,
+)
+from deeplearning4j_tpu.checkpoint.cloud import (  # noqa: F401
+    CloudCredentials,
+    CloudObjectBackend,
+    backend_from_url,
+)
+from deeplearning4j_tpu.checkpoint.cache import (  # noqa: F401
+    CachedBackend,
+)
+from deeplearning4j_tpu.checkpoint.emulator import (  # noqa: F401
+    ObjectStoreEmulator,
 )
 from deeplearning4j_tpu.checkpoint.resume import (  # noqa: F401
     CrashRecord,

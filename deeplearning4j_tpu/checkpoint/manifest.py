@@ -89,13 +89,21 @@ def atomic_write_bytes(directory: str, name: str, data: bytes,
     durable together with, never before, the payload's directory entry)."""
     tmp_dir = os.path.join(directory, TMP_DIR)
     os.makedirs(tmp_dir, exist_ok=True)
-    fd, tmp_path = tempfile.mkstemp(dir=tmp_dir, prefix=name + ".", suffix=".part")
+    # nested names ("shards/x.npz", the data-lake key shape) stage FLAT in
+    # tmp/ and land under their subdirectory on the rename
+    fd, tmp_path = tempfile.mkstemp(dir=tmp_dir,
+                                    prefix=name.replace(os.sep, "_")
+                                               .replace("/", "_") + ".",
+                                    suffix=".part")
     try:
         with os.fdopen(fd, "wb") as f:
             f.write(data)
             f.flush()
             os.fsync(f.fileno())
         final = os.path.join(directory, name)
+        parent = os.path.dirname(final)
+        if parent and not os.path.isdir(parent):
+            os.makedirs(parent, exist_ok=True)
         os.replace(tmp_path, final)
     except BaseException:
         try:

@@ -53,6 +53,8 @@ __all__ = [
     "StorageBackend", "LocalFSBackend", "ObjectStoreBackend",
     "RetryingBackend", "StorageError", "TransientStorageError",
     "PermanentStorageError", "StorageNotFoundError", "as_backend",
+    "ORPHAN_KEY_PREFIXES", "ORPHAN_KEY_SUFFIXES", "is_orphan_key",
+    "sweep_orphan_keys",
 ]
 
 
@@ -61,7 +63,17 @@ class StorageError(RuntimeError):
 
 
 class TransientStorageError(StorageError):
-    """A fault worth retrying: throttling, timeouts, flaky transport."""
+    """A fault worth retrying: throttling, timeouts, flaky transport.
+
+    ``retry_after_s`` carries a server-issued ``Retry-After`` hint when the
+    fault came off the wire (a 429/503 from an object store);
+    :class:`RetryingBackend` honors it in place of its own backoff delay,
+    capped at the configured ceiling. ``None`` means "no hint — use the
+    schedule"."""
+
+    def __init__(self, *args, retry_after_s: Optional[float] = None):
+        super().__init__(*args)
+        self.retry_after_s = retry_after_s
 
 
 class PermanentStorageError(StorageError):
@@ -109,17 +121,53 @@ class StorageBackend:
 
     # ------------------------------------------------------------- optional
     def clean_orphans(self):
-        """Remove partial-write leftovers from a crash (local tmp/ files);
-        object stores have none — puts are all-or-nothing."""
+        """Remove partial-write leftovers from a crash: local tmp files
+        (:class:`LocalFSBackend`), orphaned ``tmp-``/``.part`` keys
+        (:class:`ObjectStoreBackend`, :func:`sweep_orphan_keys`), abandoned
+        multipart uploads (``CloudObjectBackend``). Base class is a no-op
+        for backends with nothing to clean."""
 
     def describe(self) -> str:
         return type(self).__name__
 
 
+# Naming convention for keys that are, by construction, never a committed
+# object: staging keys a writer parks bytes under before its final atomic
+# commit. A crash between staging and commit leaves them behind;
+# ``clean_orphans`` sweeps them because no reader ever looks for them.
+ORPHAN_KEY_PREFIXES = ("tmp-",)
+ORPHAN_KEY_SUFFIXES = (".tmp", ".part")
+
+
+def is_orphan_key(name: str) -> bool:
+    """True when ``name`` is a staging key under the orphan convention
+    (a ``tmp-`` basename segment or a ``.tmp``/``.part`` suffix)."""
+    base = name.rsplit("/", 1)[-1]
+    return (base.startswith(ORPHAN_KEY_PREFIXES)
+            or name.endswith(ORPHAN_KEY_SUFFIXES))
+
+
+def sweep_orphan_keys(backend: "StorageBackend") -> List[str]:
+    """Delete every orphan-convention key visible in ``backend`` and return
+    the deleted names. Shared by :class:`ObjectStoreBackend` and
+    ``CloudObjectBackend`` (which additionally aborts in-flight multipart
+    uploads over the wire). Deletes are idempotent, so racing a concurrent
+    sweep is harmless."""
+    swept = [n for n in backend.list() if is_orphan_key(n)]
+    for name in swept:
+        backend.delete(name)
+    if swept:
+        log.info("swept %d orphan key(s) from %s: %s", len(swept),
+                 backend.describe(), ", ".join(swept[:8]))
+    return swept
+
+
 class LocalFSBackend(StorageBackend):
     """One directory on a local filesystem — the manager's historical
     behavior, via the same tmp + fsync + rename commit primitive
-    (manifest.atomic_write_bytes)."""
+    (manifest.atomic_write_bytes). Names may nest ("shards/x.npz", the
+    data-lake key shape): they land as subdirectories and ``list``
+    walks them back out with "/"-joined names."""
 
     def __init__(self, directory: str):
         self.directory = str(directory)
@@ -144,9 +192,18 @@ class LocalFSBackend(StorageBackend):
     def list(self, prefix: str = "") -> List[str]:
         if not os.path.isdir(self.directory):
             return []
-        return sorted(n for n in os.listdir(self.directory)
-                      if n.startswith(prefix)
-                      and os.path.isfile(os.path.join(self.directory, n)))
+        from deeplearning4j_tpu.checkpoint.manifest import TMP_DIR
+        names = []
+        for root, dirs, files in os.walk(self.directory):
+            if root == self.directory and TMP_DIR in dirs:
+                dirs.remove(TMP_DIR)  # staging area, never an object
+            rel = os.path.relpath(root, self.directory)
+            for n in files:
+                name = n if rel == "." else \
+                    os.path.join(rel, n).replace(os.sep, "/")
+                if name.startswith(prefix):
+                    names.append(name)
+        return sorted(names)
 
     def delete(self, name: str):
         try:
@@ -161,6 +218,7 @@ class LocalFSBackend(StorageBackend):
         from deeplearning4j_tpu.checkpoint.manifest import clean_tmp
         if os.path.isdir(self.directory):
             clean_tmp(self.directory)
+        return sweep_orphan_keys(self)
 
     def describe(self) -> str:
         return f"LocalFSBackend({self.directory})"
@@ -246,6 +304,16 @@ class ObjectStoreBackend(StorageBackend):
         with self._lock:
             return name in self._store
 
+    def clean_orphans(self):
+        """Sweep orphaned staging keys (``tmp-``/``.part`` convention).
+
+        Committed puts here are all-or-nothing, but clients that STAGE
+        through the store (a resumable uploader parking parts, a copier
+        writing ``<name>.tmp`` before a final put+delete) leave orphan keys
+        behind on a crash — the object-store analogue of LocalFSBackend's
+        tmp files."""
+        sweep_orphan_keys(self)
+
     def describe(self) -> str:
         return f"ObjectStoreBackend({self.bucket})"
 
@@ -259,6 +327,12 @@ class RetryingBackend(StorageBackend):
     else propagate immediately. After ``max_retries`` failed retries the
     LAST transient error is re-raised — the caller (the manager's writer
     thread) then surfaces it as a CheckpointError instead of hanging.
+
+    When a caught :class:`TransientStorageError` carries a server-issued
+    ``retry_after_s`` hint (CloudObjectBackend parses it off 429/503
+    ``Retry-After`` headers), the hint replaces that attempt's backoff
+    delay, capped at ``max_backoff_s``; hint-less faults use the jittered
+    schedule unchanged. ``retry_after_honored`` counts the substitutions.
 
     ``op_timeout_s`` bounds each attempt: the inner op runs on a worker
     thread (the watchdog's deadline pattern — a hung 9p fsync or stalled
@@ -292,6 +366,7 @@ class RetryingBackend(StorageBackend):
         self.attempts = 0
         self.retries = 0
         self.gave_up = 0
+        self.retry_after_honored = 0
 
     # ---------------------------------------------------------- core loop
     def _attempt_once(self, op: str, fn: Callable):
@@ -335,9 +410,18 @@ class RetryingBackend(StorageBackend):
                 last = e
                 if attempt >= self.max_retries:
                     break
-                delay = backoff_delay(attempt, base_s=self.base_backoff_s,
-                                      cap_s=self.max_backoff_s,
-                                      rng=self._rng)
+                hint = getattr(e, "retry_after_s", None)
+                if hint is not None:
+                    # the server said when to come back — believe it, but
+                    # never wait longer than our own backoff ceiling (a
+                    # hostile/buggy Retry-After must not stall the writer)
+                    delay = min(max(float(hint), 0.0), self.max_backoff_s)
+                    self.retry_after_honored += 1
+                else:
+                    delay = backoff_delay(attempt,
+                                          base_s=self.base_backoff_s,
+                                          cap_s=self.max_backoff_s,
+                                          rng=self._rng)
                 log.warning(
                     "storage op '%s' on %s failed (%s: %s) — retry %d/%d "
                     "in %.3fs", op, self.inner.describe(),

@@ -42,8 +42,12 @@ from deeplearning4j_tpu.datasets.records import (  # noqa: F401
     CollectionRecordReader,
     CSVRecordReader,
     CSVSequenceRecordReader,
+    CSVShardSource,
     RecordReaderDataSetIterator,
+    RecordSource,
     SequenceRecordReaderDataSetIterator,
+    ShardFileSource,
+    write_shards,
 )
 from deeplearning4j_tpu.datasets.preprocessing import (  # noqa: F401
     DataSetPreProcessor,

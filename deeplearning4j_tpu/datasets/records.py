@@ -10,12 +10,26 @@ TPU-native design: records are plain Python lists of values; batch assembly
 produces contiguous numpy arrays once per minibatch (a single host->device
 transfer per step inside the jitted program). The Writable type hierarchy
 dissolves — numpy dtype promotion does the converter's job.
+
+The FILE-BACKED tier (DataVec's distributed record readers, SURVEY L3):
+:class:`RecordSource` is the lazy counterpart of the in-RAM arrays a
+``ShardedDataset`` is normally built from — a corpus laid out as shard
+objects in ANY ``StorageBackend`` (local dir, in-process bucket,
+``CloudObjectBackend`` over the wire), loaded one shard at a time.
+:class:`ShardFileSource` reads the native ``.npz`` shard layout
+(:func:`write_shards` produces it); :class:`CSVShardSource` reads a
+prefix of CSV shard objects through the same column/label conventions as
+:class:`RecordReaderDataSetIterator`. ``ShardedDataset(source=...)``
+keeps its deterministic shuffle / lease / exactly-once semantics
+unchanged — those operate on row indices, which a source serves lazily
+with RAM bounded by the in-flight shard set.
 """
 
 from __future__ import annotations
 
 import csv
 import io
+import json
 import os
 from typing import Iterable, List, Optional, Sequence, Union
 
@@ -302,3 +316,225 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
                 seqs = []
         if seqs:
             yield self._assemble(seqs)
+
+
+# ===================================================== file-backed sources
+SHARD_META_NAME = "meta.json"
+_SHARD_FIELDS = ("features", "labels", "features_mask", "labels_mask")
+
+
+class RecordSource:
+    """A corpus as an ordered list of shard files in a StorageBackend.
+
+    The contract ``ShardedDataset(source=...)`` builds on:
+
+    - ``shard_sizes``: rows per shard, fixed at construction (global row
+      ``r`` lives at offset ``r - sum(sizes[:i])`` of shard ``i``);
+    - ``load_shard(i)`` → ``{"features": arr, "labels": arr|None,
+      "features_mask": ..., "labels_mask": ...}`` with exactly
+      ``shard_sizes[i]`` rows — loaded on demand, never retained here
+      (residency is the dataset's LRU's job);
+    - ``feature_shape``/``label_shape``: per-record trailing shapes, known
+      WITHOUT loading any shard (readers size their models from these).
+    """
+
+    shard_sizes: List[int]
+    feature_shape: tuple
+    label_shape: Optional[tuple]
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.shard_sizes)
+
+    @property
+    def num_records(self) -> int:
+        return sum(self.shard_sizes)
+
+    def load_shard(self, index: int) -> dict:
+        raise NotImplementedError
+
+    def describe(self) -> str:
+        return type(self).__name__
+
+
+def _shard_key(prefix: str, index: int) -> str:
+    return f"{prefix}shard-{index:05d}.npz"
+
+
+def write_shards(store, prefix: str, features, labels=None, *,
+                 records_per_shard: int, features_mask=None,
+                 labels_mask=None) -> "ShardFileSource":
+    """Lay a corpus out as the native shard format: one ``.npz`` object
+    per ``records_per_shard`` rows plus a trailing ``meta.json`` under
+    ``prefix`` in any backend. The meta object is written LAST — it is
+    the commit point a :class:`ShardFileSource` discovers the corpus
+    through, so a writer that dies mid-layout leaves nothing readable."""
+    from deeplearning4j_tpu.checkpoint.storage import as_backend
+    backend = as_backend(store)
+    features = np.asarray(features)
+    n = int(features.shape[0])
+    if records_per_shard < 1:
+        raise ValueError("records_per_shard must be >= 1")
+    arrays = {"features": features,
+              "labels": None if labels is None else np.asarray(labels),
+              "features_mask": (None if features_mask is None
+                                else np.asarray(features_mask)),
+              "labels_mask": (None if labels_mask is None
+                              else np.asarray(labels_mask))}
+    for field, arr in arrays.items():
+        if arr is not None and arr.shape[0] != n:
+            raise ValueError(f"{field} has {arr.shape[0]} rows, "
+                             f"features has {n}")
+    sizes = []
+    for i, lo in enumerate(range(0, n, records_per_shard)):
+        hi = min(n, lo + records_per_shard)
+        buf = io.BytesIO()
+        np.savez(buf, **{f: a[lo:hi] for f, a in arrays.items()
+                         if a is not None})
+        backend.put(_shard_key(prefix, i), buf.getvalue())
+        sizes.append(hi - lo)
+    meta = {"version": 1, "shard_sizes": sizes,
+            "feature_shape": list(features.shape[1:]),
+            "label_shape": (None if arrays["labels"] is None
+                            else list(arrays["labels"].shape[1:])),
+            "fields": [f for f, a in arrays.items() if a is not None]}
+    backend.put(prefix + SHARD_META_NAME,
+                json.dumps(meta, sort_keys=True).encode())
+    return ShardFileSource(backend, prefix)
+
+
+class ShardFileSource(RecordSource):
+    """The native shard-file layout: ``<prefix>shard-NNNNN.npz`` objects
+    described by ``<prefix>meta.json`` (see :func:`write_shards`), over
+    any StorageBackend — the lake path feeds training through
+    ``CloudObjectBackend`` + ``CachedBackend`` with exactly this class."""
+
+    def __init__(self, store, prefix: str = "shards/"):
+        from deeplearning4j_tpu.checkpoint.storage import as_backend
+        self.store = as_backend(store)
+        self.prefix = str(prefix)
+        try:
+            meta = json.loads(self.store.get(self.prefix +
+                                             SHARD_META_NAME).decode())
+        except FileNotFoundError:
+            raise FileNotFoundError(
+                f"no shard corpus at prefix {self.prefix!r} in "
+                f"{self.store.describe()} — write_shards() commits "
+                f"{SHARD_META_NAME} last; its absence means no corpus "
+                "(or a writer that died mid-layout)") from None
+        self.shard_sizes = [int(s) for s in meta["shard_sizes"]]
+        self.feature_shape = tuple(meta["feature_shape"])
+        self.label_shape = (None if meta.get("label_shape") is None
+                            else tuple(meta["label_shape"]))
+        self.fields = tuple(meta.get("fields", ("features",)))
+        self.loads = 0
+        self.bytes_loaded = 0
+
+    def load_shard(self, index: int) -> dict:
+        data = self.store.get(_shard_key(self.prefix, index))
+        self.loads += 1
+        self.bytes_loaded += len(data)
+        with np.load(io.BytesIO(data), allow_pickle=False) as z:
+            out = {f: (np.asarray(z[f]) if f in z.files else None)
+                   for f in _SHARD_FIELDS}
+        got = 0 if out["features"] is None else out["features"].shape[0]
+        if got != self.shard_sizes[index]:
+            raise ValueError(
+                f"shard {index} of {self.describe()} has {got} rows, "
+                f"meta says {self.shard_sizes[index]} — corpus rewritten "
+                "under a live reader?")
+        return out
+
+    def describe(self) -> str:
+        return f"ShardFileSource({self.store.describe()}, {self.prefix!r})"
+
+
+class CSVShardSource(RecordSource):
+    """CSV shard objects under a prefix (DataVec's CSV readers over an
+    object store): every object ``<prefix>*`` is one shard, shards ordered
+    by name. Label handling follows
+    :class:`RecordReaderDataSetIterator` — ``label_index`` column one-hot
+    to ``num_possible_labels`` wide (or kept scalar under
+    ``regression=True``); without a ``label_index`` the rows are
+    features-only. Labels must be NUMERIC class ids — string labels would
+    need a first-appearance map whose order depends on shard visit order,
+    which a deterministic shuffle cannot allow.
+
+    Row counts are taken in one pass over the corpus at construction
+    (each object read once — through a ``CachedBackend`` that pass also
+    warms the cache); bytes are NOT retained."""
+
+    def __init__(self, store, prefix: str, *, label_index: int = -1,
+                 num_possible_labels: int = -1, regression: bool = False,
+                 skip_lines: int = 0, delimiter: str = ","):
+        from deeplearning4j_tpu.checkpoint.storage import as_backend
+        self.store = as_backend(store)
+        self.prefix = str(prefix)
+        self.label_index = int(label_index)
+        self.num_possible_labels = int(num_possible_labels)
+        self.regression = bool(regression)
+        self.skip_lines = int(skip_lines)
+        self.delimiter = delimiter
+        if not regression and label_index >= 0 and num_possible_labels <= 0:
+            raise ValueError("Classification mode needs num_possible_labels")
+        self.shard_names = [n for n in self.store.list(prefix=self.prefix)
+                            if not n.endswith(SHARD_META_NAME)]
+        if not self.shard_names:
+            raise FileNotFoundError(
+                f"no CSV shards under prefix {self.prefix!r} in "
+                f"{self.store.describe()}")
+        self.loads = 0
+        self.bytes_loaded = 0
+        sizes, widths = [], set()
+        for name in self.shard_names:
+            rows = self._parse(self.store.get(name), name)
+            sizes.append(rows.shape[0])
+            widths.add(rows.shape[1])
+        if len(widths) != 1:
+            raise ValueError(f"CSV shards disagree on column count: "
+                             f"{sorted(widths)}")
+        self.shard_sizes = sizes
+        width = widths.pop()
+        n_feat = width - (1 if self.label_index >= 0 else 0)
+        self.feature_shape = (n_feat,)
+        if self.label_index < 0:
+            self.label_shape = None
+        elif self.regression:
+            self.label_shape = (1,)
+        else:
+            self.label_shape = (self.num_possible_labels,)
+
+    def _parse(self, data: bytes, name: str) -> np.ndarray:
+        self.loads += 1
+        self.bytes_loaded += len(data)
+        reader = CSVRecordReader(data.decode("utf-8"),
+                                 skip_lines=self.skip_lines,
+                                 delimiter=self.delimiter)
+        mat = reader.numeric_matrix()
+        if mat is None:
+            rows = list(reader)
+            if any(isinstance(v, str) for r in rows for v in r):
+                raise ValueError(
+                    f"CSV shard {name} has non-numeric fields — lake CSV "
+                    "shards must be fully numeric (see class docstring)")
+            mat = np.asarray(rows, np.float32)
+        if mat.ndim != 2:
+            raise ValueError(f"CSV shard {name} is empty or ragged")
+        return mat
+
+    def load_shard(self, index: int) -> dict:
+        name = self.shard_names[index]
+        mat = self._parse(self.store.get(name), name)
+        li = self.label_index
+        if li < 0:
+            return {"features": mat, "labels": None,
+                    "features_mask": None, "labels_mask": None}
+        labels = mat[:, li:li + 1]
+        feats = np.concatenate([mat[:, :li], mat[:, li + 1:]], axis=1)
+        if not self.regression:
+            labels = _one_hot(labels[:, 0], self.num_possible_labels)
+        return {"features": feats, "labels": labels.astype(np.float32),
+                "features_mask": None, "labels_mask": None}
+
+    def describe(self) -> str:
+        return f"CSVShardSource({self.store.describe()}, {self.prefix!r})"

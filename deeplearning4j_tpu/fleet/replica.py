@@ -152,13 +152,22 @@ def restore_and_serve(store, models: List[Tuple[str, str]], *,
                       poll_secs: Optional[float] = None,
                       ttl_s: float = DEFAULT_TTL_S,
                       wait_ready_s: float = 300.0,
-                      compile_cache_dir: Optional[str] = None
+                      compile_cache_dir: Optional[str] = None,
+                      cache_dir: Optional[str] = None
                       ) -> "ServingReplica":
     """Subprocess-shaped replica bring-up: restore each ``(name,
-    ckpt_dir)`` model's latest checkpoint (inheriting any ``TuningRecord``
-    riding it — warmup then compiles the exact serving ladder), register
-    everything on a fresh ModelServer, start and announce. Returns the
-    running replica; the caller owns the lifetime (``stop()``).
+    ckpt_target)`` model's latest checkpoint (inheriting any
+    ``TuningRecord`` riding it — warmup then compiles the exact serving
+    ladder), register everything on a fresh ModelServer, start and
+    announce. Returns the running replica; the caller owns the lifetime
+    (``stop()``).
+
+    ``ckpt_target`` is a local directory OR a backend URL
+    (``http(s)://host:port/bucket``, ``mem:[name]``, ``file:/path`` —
+    see :func:`~deeplearning4j_tpu.checkpoint.cloud.backend_from_url`):
+    a URL target restores straight from the data lake. ``cache_dir``
+    wraps URL targets in a :class:`CachedBackend` so a restarted replica
+    re-reads its checkpoint bytes from local disk instead of the wire.
 
     ``compile_cache_dir`` points JAX's persistent compilation cache at a
     shared directory (``perf.compile_cache``): the SECOND cold start of
@@ -166,6 +175,7 @@ def restore_and_serve(store, models: List[Tuple[str, str]], *,
     re-running XLA — the instant-start lever on top of the warmed
     TuningRecord ladder."""
     from deeplearning4j_tpu.checkpoint import CheckpointManager
+    from deeplearning4j_tpu.checkpoint.cloud import backend_from_url
     from deeplearning4j_tpu.serving import ModelServer
 
     server = ModelServer(port=port, bind_address=bind_address,
@@ -174,7 +184,11 @@ def restore_and_serve(store, models: List[Tuple[str, str]], *,
                          compile_cache_dir=compile_cache_dir)
     managers = []
     for name, ckpt_dir in models:
-        cm = CheckpointManager(ckpt_dir)
+        if "://" in ckpt_dir or ckpt_dir.startswith("mem:"):
+            backend = backend_from_url(ckpt_dir, cache_dir=cache_dir)
+            cm = CheckpointManager(storage=backend)
+        else:
+            cm = CheckpointManager(ckpt_dir)
         managers.append(cm)
         net = cm.restore_latest(load_updater=False)
         if net is None:

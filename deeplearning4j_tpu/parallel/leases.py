@@ -75,6 +75,7 @@ class LeaseBoard:
         self._thread: Optional[threading.Thread] = None
         self.heartbeat_errors = 0
         self.payload_errors = 0
+        self.read_errors = 0
 
     # ------------------------------------------------------------- writing
     def set_payload(self, **fields):
@@ -144,15 +145,20 @@ class LeaseBoard:
 
     # ------------------------------------------------------------- reading
     def read_all(self) -> Dict[str, dict]:
-        """Every parseable lease in the store, by worker id."""
+        """Every parseable lease in the store, by worker id.
+
+        A lease that cannot be fetched or parsed counts as absent (=
+        expired) for THIS scan rather than failing the whole membership
+        view — over a cloud backend one transient fault on one key must
+        not make every peer look dead. ``read_errors`` counts the skips
+        so persistent corruption stays visible."""
         out = {}
         for name in self.store.list(prefix=self.prefix):
             try:
                 rec = json.loads(self.store.get(name).decode())
                 out[str(rec["worker_id"])] = rec
             except Exception as e:
-                # an unreadable lease counts as absent (= expired); log so
-                # persistent corruption is visible
+                self.read_errors += 1
                 log.warning("unreadable lease %s (%s: %s)", name,
                             type(e).__name__, e)
         return out

@@ -149,8 +149,13 @@ def _chunk_pass(source):
     Re-startable sources (the two-pass contract): a CALLABLE returning a
     fresh iterator (the generator-factory idiom), a ``DataSetIterator``
     (``ShardedReader`` included — ``reset()`` then iterate, taking each
-    batch's flattened features), an ``(n, d)`` array (sliced), or a
+    batch's flattened features), a ``ShardedDataset`` (its rank-0 reader;
+    a lake-backed ``source=`` dataset streams shard files through
+    whatever backend stack it was built over — CloudObjectBackend +
+    CachedBackend included), an ``(n, d)`` array (sliced), or a
     re-iterable of arrays (list/tuple)."""
+    if hasattr(source, "reader") and hasattr(source, "epoch_order"):
+        source = source.reader()  # ShardedDataset → its full-plan reader
     if callable(source):
         it = source()
     elif hasattr(source, "reset") and hasattr(source, "__iter__"):

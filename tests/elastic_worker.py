@@ -10,6 +10,10 @@ through ``train_until_process`` (tests/test_resilience.py) — the worker
 learns everything from the config file: world expectations, kill
 schedule (FaultInjector ``kill_mode="process"`` = real SIGKILL), chaos on
 the membership path (FlakyBackend over the rendezvous store), timings.
+``CFG["data_plane"]`` trains from the lease-based sharded data plane;
+``CFG["lake"]`` goes further — shard files, data leases and the ledger
+all live in the parent's fault-scripted object-store emulator, reached
+through CloudObjectBackend (+ optional per-worker disk cache).
 
 Outputs (under ``out_dir``):
 
@@ -89,13 +93,34 @@ def _global_batches():
     return DataSet(x, y).split(batch)
 
 
+def _install_fetch_kill(sds, wid, mode_cfg):
+    """Arm the optional fetch-time kill (``kill_at_fetch: {wid: {epoch,
+    batch}}``): SIGKILL THIS worker when its reader is asked for that
+    global batch — a preemption landing between steps, the exactly-once
+    acceptance shape."""
+    kill = (mode_cfg.get("kill_at_fetch") or {}).get(wid)
+    if not kill or (kill.get("first_attempt_only") and _ATTEMPT > 1):
+        return
+    target = (int(kill["epoch"]), int(kill["batch"]))
+
+    def fetch_hook(epoch, batch_idx):
+        if (epoch, batch_idx) == target:
+            from deeplearning4j_tpu.obs.flight import (
+                flush_flight_recorder)
+            try:
+                flush_flight_recorder(
+                    f"data-plane kill at fetch e{epoch} b{batch_idx}")
+            except Exception:
+                pass
+            import signal
+            os.kill(os.getpid(), signal.SIGKILL)
+    sds.fetch_hook = fetch_hook
+
+
 def _sharded_dataset(wid):
     """CFG['data_plane'] mode: the lease-based sharded data plane
     (datasets/sharded.py) over the same deterministic records —
-    ElasticWorker builds a per-generation reader from it. The optional
-    fetch-time kill (``kill_at_fetch: {wid: {epoch, batch}}``) SIGKILLs
-    THIS worker when its reader is asked for that global batch — a
-    preemption landing between steps, the exactly-once acceptance shape."""
+    ElasticWorker builds a per-generation reader from it."""
     from deeplearning4j_tpu.checkpoint import LocalFSBackend
     from deeplearning4j_tpu.datasets.sharded import ShardedDataset
     dp = CFG["data_plane"]
@@ -109,21 +134,47 @@ def _sharded_dataset(wid):
         ledger=bool(dp.get("ledger", True)),
         lease_ttl_s=float(CFG.get("lease_ttl_s", 3.0)),
         lease_batches=int(dp.get("lease_batches", 2)))
-    kill = (dp.get("kill_at_fetch") or {}).get(wid)
-    if kill and not (kill.get("first_attempt_only") and _ATTEMPT > 1):
-        target = (int(kill["epoch"]), int(kill["batch"]))
-        def fetch_hook(epoch, batch_idx):
-            if (epoch, batch_idx) == target:
-                from deeplearning4j_tpu.obs.flight import (
-                    flush_flight_recorder)
-                try:
-                    flush_flight_recorder(
-                        f"data-plane kill at fetch e{epoch} b{batch_idx}")
-                except Exception:
-                    pass
-                import signal
-                os.kill(os.getpid(), signal.SIGKILL)
-        sds.fetch_hook = fetch_hook
+    _install_fetch_kill(sds, wid, dp)
+    return sds
+
+
+def _lake_dataset(wid):
+    """CFG['lake'] mode: the data-plane shape with NOTHING local — shard
+    files, data leases and the consumption ledger all live in the
+    fault-scripted object-store emulator the parent started, reached
+    through the real wire client behind bounded retries. Shard bytes are
+    pulled lazily (RAM bounded by ``max_resident_shards``) and, when
+    ``cache`` is on, through a per-worker on-disk CachedBackend — a
+    respawned attempt re-reads its shards from disk, not the wire."""
+    from deeplearning4j_tpu.checkpoint import RetryingBackend
+    from deeplearning4j_tpu.checkpoint.cache import CachedBackend
+    from deeplearning4j_tpu.checkpoint.cloud import CloudObjectBackend
+    from deeplearning4j_tpu.datasets.records import ShardFileSource
+    from deeplearning4j_tpu.datasets.sharded import ShardedDataset
+    lk = CFG["lake"]
+    retry = RetryingBackend(
+        CloudObjectBackend(lk["endpoint"], lk.get("bucket", "lake"),
+                           access_key=lk.get("access_key"),
+                           secret_key=lk.get("secret_key"),
+                           timeout_s=10.0),
+        max_retries=8, base_backoff_s=0.02, max_backoff_s=0.5)
+    shard_store = retry
+    if lk.get("cache"):
+        # shard files are immutable so a disk cache is safe; leases and
+        # the ledger are mutable and MUST stay on the raw retrying store
+        shard_store = CachedBackend(
+            retry, os.path.join(CFG["store_dir"], f"lake-cache-{wid}"),
+            max_bytes=int(lk.get("cache_bytes", 64 << 20)))
+    source = ShardFileSource(shard_store, lk.get("prefix", "shards/"))
+    sds = ShardedDataset(
+        source=source, batch_size=int(CFG.get("batch", 24)),
+        seed=int(lk.get("seed", 9)), store=retry,
+        ledger=bool(lk.get("ledger", True)),
+        lease_ttl_s=float(CFG.get("lease_ttl_s", 3.0)),
+        lease_batches=int(lk.get("lease_batches", 2)),
+        max_resident_shards=int(lk.get("max_resident_shards", 2)))
+    sds._lake_shard_store = shard_store  # stats surfaced in done-json
+    _install_fetch_kill(sds, wid, lk)
     return sds
 
 
@@ -208,7 +259,8 @@ def main():
         init_timeout_s=int(CFG.get("init_timeout_s", 30)),
         on_generation=on_generation)
 
-    data = (_sharded_dataset(wid) if CFG.get("data_plane")
+    data = (_lake_dataset(wid) if CFG.get("lake")
+            else _sharded_dataset(wid) if CFG.get("data_plane")
             else _global_batches())
     try:
         summary = worker.run(_model_factory, data,
@@ -217,19 +269,32 @@ def main():
         print(f"{wid}: elastic restart required: {e}", flush=True)
         os._exit(ELASTIC_RESTART_EXIT)
 
+    done = {
+        "worker": wid,
+        "epochs": summary.model.epoch,
+        "iteration": summary.model.iteration,
+        "state_sha": shd.state_sha(summary.model),
+        "evictions": summary.evictions,
+        "generations": [{
+            "generation": g.generation, "world": g.world_size,
+            "rank": g.rank, "epochs": g.epochs, "ended": g.ended,
+            "restored_from": g.restored_from,
+        } for g in summary.generations],
+    }
+    if CFG.get("lake"):
+        # shard-resident accounting: the parent asserts RAM stayed
+        # bounded by in-flight shards, not the corpus
+        done["lake"] = {
+            "shard_loads": int(data.shard_loads),
+            "shard_hits": int(data.shard_hits),
+            "shard_evictions": int(data.shard_evictions),
+            "peak_resident_bytes": int(data.peak_resident_bytes),
+        }
+        cache = getattr(data, "_lake_shard_store", None)
+        if cache is not None and hasattr(cache, "stats"):
+            done["lake"]["cache"] = cache.stats()
     with open(os.path.join(out_dir, f"done-{wid}.json"), "w") as f:
-        json.dump({
-            "worker": wid,
-            "epochs": summary.model.epoch,
-            "iteration": summary.model.iteration,
-            "state_sha": shd.state_sha(summary.model),
-            "evictions": summary.evictions,
-            "generations": [{
-                "generation": g.generation, "world": g.world_size,
-                "rank": g.rank, "epochs": g.epochs, "ended": g.ended,
-                "restored_from": g.restored_from,
-            } for g in summary.generations],
-        }, f)
+        json.dump(done, f)
     print(f"{wid}-done", flush=True)
     os._exit(0)
 

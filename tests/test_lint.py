@@ -1065,6 +1065,61 @@ class TestBlockingIoWithoutTimeout:
         """, path=self.PATH) == []
 
 
+class TestUnboundedLakeIo:
+    PATH = "deeplearning4j_tpu/checkpoint/cloud.py"
+
+    def test_fires_on_unbounded_response_read(self):
+        vs = _lint("""
+            import http.client
+            def fetch(host):
+                conn = http.client.HTTPConnection(host, timeout=5.0)
+                conn.request("GET", "/o")
+                return conn.getresponse().read()
+        """, path=self.PATH)
+        assert _rules(vs) == ["DLT021"]
+        assert "byte bound" in vs[0].message
+
+    def test_fires_on_unbounded_recv_and_readline(self):
+        vs = _lint("""
+            def drain(sock, f):
+                return sock.recv(), f.readline()
+        """, path="deeplearning4j_tpu/checkpoint/emulator.py")
+        assert _rules(vs) == ["DLT021", "DLT021"]
+
+    def test_fires_on_connection_without_timeout(self):
+        vs = _lint("""
+            import http.client
+            def connect(host):
+                return http.client.HTTPConnection(host)
+        """, path="deeplearning4j_tpu/tools/lake.py")
+        assert _rules(vs) == ["DLT021"]
+        assert "timeout" in vs[0].message
+
+    def test_clean_when_bounded_and_timed(self):
+        assert _lint("""
+            import http.client
+            def fetch(host, n):
+                conn = http.client.HTTPConnection(host, timeout=5.0)
+                conn.request("GET", "/o")
+                return conn.getresponse().read(n)
+        """, path=self.PATH) == []
+
+    def test_out_of_scope_path_is_exempt(self):
+        # DLT021 is the lake-path extension of DLT016 — neither fires
+        # on a path outside both scopes
+        assert _lint("""
+            def fetch(resp):
+                return resp.read()
+        """, path="deeplearning4j_tpu/datasets/fetchers.py") == []
+
+    def test_inline_waiver(self):
+        assert _lint("""
+            def drain(resp):
+                # stream provably bounded by the framing layer above
+                return resp.read()  # lint: disable=DLT021
+        """, path=self.PATH) == []
+
+
 class TestPerTokenHostTransfer:
     PATH = "deeplearning4j_tpu/serving/decode.py"
 
